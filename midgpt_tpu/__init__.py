@@ -1,0 +1,26 @@
+"""midgpt_tpu — a TPU-native LLM pretraining framework.
+
+Capability parity with AllanYangZhou/midGPT (reference at /root/reference),
+rebuilt TPU-first: batched-native models, a 4-axis
+(replica, fsdp, sequence, tensor) device mesh with declarative sharding
+rules, and Pallas flash-attention kernels. (Planned, tracked in SURVEY.md 7:
+ring attention, trainer + async Orbax checkpointing, KV-cached sampler.)
+"""
+
+from midgpt_tpu.config import (
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    get_config,
+    list_configs,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ExperimentConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "get_config",
+    "list_configs",
+]

@@ -1,0 +1,214 @@
+"""Experiment / model configuration.
+
+Capability parity with the reference's config system
+(/root/reference/src/train.py:26-44 ``ExperimentConfig``,
+/root/reference/src/model.py:108-115 ``GPTConfig``,
+/root/reference/launch.py:25-27 name-based resolution,
+/root/reference/sample.py:49-65 JSON round-trip), redesigned:
+
+- nested dataclasses with a generic JSON (de)serializer instead of the
+  hand-rolled ``from_json``;
+- a mesh spec (``MeshConfig``) making DP / FSDP / SP / TP axis sizes explicit
+  instead of the hardcoded ``(n_devices // 8, 8)`` mesh (train.py:130);
+- named registry populated by ``midgpt_tpu.configs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing as tp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture. Superset of the reference GPTConfig (model.py:108-115):
+    adds GQA (n_kv_head), SwiGLU (mlp), and kernel/remat knobs for the
+    Llama-style family required by BASELINE.json."""
+
+    block_size: int  # max sequence length
+    vocab_size: int
+    n_layer: int
+    n_head: int
+    n_embd: int
+    dropout: float = 0.0
+    n_kv_head: tp.Optional[int] = None  # None => MHA (= n_head); < n_head => GQA
+    mlp: str = "gelu"  # "gelu" (GPT-2 style, 4x) | "swiglu" (Llama style)
+    mlp_ratio: float = 4.0  # hidden = ratio * n_embd (swiglu: per-branch width)
+    rope_base: float = 10000.0
+    qk_norm: bool = True  # per-head QK-LayerNorm (model.py:52-53)
+    tie_embeddings: bool = False  # True = one shared param (true tying);
+    # False = reference semantics: shared init, independent params
+    # (model.py:134-138, SURVEY.md 2.3)
+    attn_impl: str = "auto"  # auto | naive | flash | ring
+    remat: str = "full"  # full | dots | none  (model.py:149 uses full)
+    scan_unroll: int = 1  # lax.scan unroll over layers (model.py:154-155)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head if self.n_kv_head is not None else self.n_head
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh axis sizes. -1 on at most one axis means "all remaining
+    devices". Axis roles:
+      replica  - pure DP, gradients all-reduced (DCN axis for multi-slice)
+      fsdp     - DP + parameter/optimizer sharding (ZeRO-3)
+      sequence - context parallelism (ring attention)
+      tensor   - Megatron-style tensor parallelism
+    """
+
+    replica: int = 1
+    fsdp: int = -1
+    sequence: int = 1
+    tensor: int = 1
+
+    # number of slices for hybrid ICI/DCN meshes; 1 = single slice
+    num_slices: int = 1
+
+    @property
+    def axis_names(self) -> tp.Tuple[str, ...]:
+        return ("replica", "fsdp", "sequence", "tensor")
+
+    def sizes(self, n_devices: int) -> tp.Tuple[int, ...]:
+        sizes = [self.replica, self.fsdp, self.sequence, self.tensor]
+        if -1 in sizes:
+            known = 1
+            for s in sizes:
+                if s != -1:
+                    known *= s
+            assert n_devices % known == 0, (
+                f"cannot infer -1 axis: {n_devices} devices, fixed product {known}"
+            )
+            sizes[sizes.index(-1)] = n_devices // known
+        total = 1
+        for s in sizes:
+            total *= s
+        assert total == n_devices, (
+            f"mesh {sizes} does not cover {n_devices} devices"
+        )
+        return tuple(sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Full experiment schema (parity: /root/reference/src/train.py:26-44)."""
+
+    model: ModelConfig
+    rundir: str = ""
+    data_dir: str = ""
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    min_lr: float = 3e-5
+    lr_decay_steps: int = 5000
+    max_steps: int = 5000
+    batch_size: int = 32  # GLOBAL batch size (train.py:31)
+    g_accum_iters: int = 1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    weight_decay: float = 1e-4
+    grad_clip: float = 1.0
+    independent_wd: bool = True  # add_decayed_weights(wd / lr) (train.py:156)
+    eval_interval: int = 1000
+    eval_batches: int = 200  # (train.py:110)
+    log_interval: int = 20  # wandb loss logging cadence (train.py:212)
+    ckpt_interval: tp.Optional[int] = None  # None => eval_interval (train.py:143)
+    ckpt_keep: int = 1  # max_to_keep (train.py:141)
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    seed: int = 0
+    data_seed: int = 1234  # seeded loader (fixes train.py:60 nondeterminism)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    debug: bool = False
+
+    @property
+    def microbatch_size(self) -> int:
+        assert self.batch_size % self.g_accum_iters == 0
+        return self.batch_size // self.g_accum_iters
+
+
+# ---------------------------------------------------------------------------
+# JSON round-trip (generic over the nested dataclasses above)
+# ---------------------------------------------------------------------------
+
+
+def to_dict(cfg: tp.Any) -> tp.Any:
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        return {f.name: to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, (list, tuple)):
+        return [to_dict(v) for v in cfg]
+    return cfg
+
+
+def _from_dict(cls: tp.Any, data: tp.Any) -> tp.Any:
+    if data is None:
+        return None
+    if dataclasses.is_dataclass(cls):
+        kwargs = {}
+        hints = tp.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            if f.name not in data:
+                continue
+            ftype = hints[f.name]
+            # unwrap Optional[X]
+            origin = tp.get_origin(ftype)
+            if origin is tp.Union:
+                args = [a for a in tp.get_args(ftype) if a is not type(None)]
+                ftype = args[0] if args else ftype
+            if dataclasses.is_dataclass(ftype):
+                kwargs[f.name] = _from_dict(ftype, data[f.name])
+            else:
+                kwargs[f.name] = data[f.name]
+        return cls(**kwargs)
+    return data
+
+
+def to_json(cfg: ExperimentConfig) -> str:
+    return json.dumps(to_dict(cfg), indent=2)
+
+
+def from_json(s: str) -> ExperimentConfig:
+    return _from_dict(ExperimentConfig, json.loads(s))
+
+
+def from_dict(d: tp.Mapping[str, tp.Any]) -> ExperimentConfig:
+    return _from_dict(ExperimentConfig, d)
+
+
+# ---------------------------------------------------------------------------
+# Named registry (parity: launch.py:25-27 dynamic import by name)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: tp.Dict[str, tp.Callable[[], ExperimentConfig]] = {}
+
+
+def register(name: str):
+    def deco(fn: tp.Callable[[], ExperimentConfig]):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_config(name: str, **overrides) -> ExperimentConfig:
+    # populate registry
+    from midgpt_tpu import configs as _  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown config {name!r}; have {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def list_configs() -> tp.List[str]:
+    from midgpt_tpu import configs as _  # noqa: F401
+
+    return sorted(_REGISTRY)

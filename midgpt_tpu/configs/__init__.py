@@ -1,0 +1,122 @@
+"""Named experiment configs.
+
+Parity with /root/reference/src/configs/*.py (see SURVEY.md 2.2 config
+matrix) plus the BASELINE.json additions (Llama-style 7B, multi-slice xl).
+Each function returns a fresh ExperimentConfig; select by name via
+``midgpt_tpu.get_config(name)``.
+"""
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig, ModelConfig, register
+
+
+@register("shakespeare_char")
+def shakespeare_char() -> ExperimentConfig:
+    """Char-level tiny GPT (parity: configs/shakespeare_char.py)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            block_size=256, vocab_size=65, n_layer=6, n_head=6, n_embd=384,
+            dropout=0.2,
+        ),
+        data_dir="data/shakespeare_char",
+        learning_rate=1e-3, min_lr=1e-4, warmup_steps=100,
+        lr_decay_steps=5000, max_steps=5000,
+        batch_size=64, g_accum_iters=1,
+        beta2=0.99, weight_decay=1e-4,
+        eval_interval=2000,
+    )
+
+
+@register("openwebtext")
+def openwebtext() -> ExperimentConfig:
+    """GPT-2-small 124M single host (parity: configs/openwebtext.py)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            block_size=1024, vocab_size=50304, n_layer=12, n_head=12,
+            n_embd=768, dropout=0.0,
+        ),
+        data_dir="data/openwebtext",
+        learning_rate=1e-3, min_lr=1e-5, warmup_steps=5000,
+        lr_decay_steps=60000, max_steps=60000,
+        batch_size=128, g_accum_iters=16,  # effective 2048
+        beta2=0.95, weight_decay=1e-4,
+        eval_interval=1000,
+    )
+
+
+@register("openwebtext_mh")
+def openwebtext_mh() -> ExperimentConfig:
+    """124M multihost (parity: configs/openwebtext_mh.py)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        openwebtext(),
+        batch_size=2048, g_accum_iters=1,
+        data_dir="/mnt/disks/persist/openwebtext",
+    )
+
+
+@register("openwebtext_xl")
+def openwebtext_xl() -> ExperimentConfig:
+    """GPT-2-XL 1.5B, FSDP x TP mesh (parity: configs/openwebtext_xl.py +
+    BASELINE.json north star: TP=4)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            block_size=1024, vocab_size=50304, n_layer=24, n_head=16,
+            n_embd=2048, dropout=0.0, attn_impl="auto",
+        ),
+        data_dir="/mnt/disks/persist/openwebtext",
+        learning_rate=1e-3, min_lr=1e-5, warmup_steps=2500,
+        lr_decay_steps=25000, max_steps=25000,
+        batch_size=1024, g_accum_iters=1,
+        beta2=0.95, weight_decay=1e-4,
+        eval_interval=1000,
+        mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
+    )
+
+
+@register("openwebtext_xl_multislice")
+def openwebtext_xl_multislice() -> ExperimentConfig:
+    """1.5B on 2 slices over DCN, DP across slices (BASELINE.json config 5)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        openwebtext_xl(),
+        mesh=MeshConfig(replica=2, fsdp=-1, sequence=1, tensor=4, num_slices=2),
+    )
+
+
+@register("llama_7b")
+def llama_7b() -> ExperimentConfig:
+    """Llama-style 7B: SwiGLU + GQA (BASELINE.json config 4)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            block_size=2048, vocab_size=50304, n_layer=32, n_head=32,
+            n_kv_head=8, n_embd=4096, dropout=0.0,
+            mlp="swiglu", mlp_ratio=8 / 3,  # ~11008 hidden, Llama-style
+            attn_impl="auto",
+        ),
+        data_dir="/mnt/disks/persist/openwebtext",
+        learning_rate=3e-4, min_lr=3e-5, warmup_steps=2000,
+        lr_decay_steps=50000, max_steps=50000,
+        batch_size=512, g_accum_iters=1,
+        beta2=0.95, weight_decay=1e-4,
+        eval_interval=1000,
+        mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
+    )
+
+
+@register("tiny")
+def tiny() -> ExperimentConfig:
+    """Minutes-scale config for tests and smoke runs."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            block_size=64, vocab_size=256, n_layer=2, n_head=2, n_embd=64,
+            dropout=0.0, attn_impl="naive",
+        ),
+        data_dir="",
+        learning_rate=1e-3, min_lr=1e-4, warmup_steps=10,
+        lr_decay_steps=100, max_steps=100,
+        batch_size=8, g_accum_iters=2,
+        beta2=0.99, weight_decay=1e-4,
+        eval_interval=50, eval_batches=4, log_interval=10,
+    )

@@ -1,0 +1,4 @@
+from midgpt_tpu.models.gpt import GPT, GPT_PARAM_RULES, Attention, Block, MLP, count_params
+from midgpt_tpu.models import layers
+
+__all__ = ["GPT", "GPT_PARAM_RULES", "Attention", "Block", "MLP", "count_params", "layers"]

@@ -1,0 +1,332 @@
+"""Decoder-only transformer (GPT / Llama family), batched-native.
+
+Capability parity with /root/reference/src/model.py (GPT: RoPE, weightless
+RMSNorm pre-norms, per-head QK-LayerNorm, GELU MLP, scan-over-layers with
+whole-block remat, init-shared wte/lm_head), redesigned TPU-first:
+
+- operates on whole ``[B, T]`` batches (one big MXU matmul per projection)
+  instead of per-sequence modules vmapped by the caller (model.py:140-158);
+- fused QKV projection sized ``(H + 2*Hkv) * C`` so GQA (Llama family,
+  BASELINE.json configs) falls out of the same code path;
+- optional SwiGLU MLP and weighted RMSNorms for the Llama-style family;
+- activation shardings tagged with logical axis names
+  (midgpt_tpu.parallel.sharding) so DP/FSDP/SP/TP are rule-table entries;
+- attention is dispatched (naive oracle / Pallas flash / ring) via
+  midgpt_tpu.ops.attention.
+
+Layer stacking: blocks are created with ``jax.vmap`` over the layer axis and
+iterated with ``lax.scan`` (+ configurable remat) for O(1) compile time in
+depth (parity: model.py:130-155).
+"""
+
+from __future__ import annotations
+
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.config import ModelConfig
+from midgpt_tpu.models.layers import (
+    Embedding,
+    LayerNorm,
+    Linear,
+    RMSNorm,
+    apply_rotary,
+    dropout,
+    rope_tables,
+)
+from midgpt_tpu.ops.attention import attention
+from midgpt_tpu.parallel.sharding import shard_act
+from midgpt_tpu.pytree import module, static
+
+Array = jax.Array
+KeyArray = jax.Array
+
+
+@module
+class Attention:
+    """Causal self-attention with QK-norm + RoPE (parity: model.py:34-81)."""
+
+    wqkv: Linear  # [D, (H + 2*Hkv) * C]
+    wo: Linear  # [H*C, D]
+    q_norm: tp.Optional[LayerNorm]
+    k_norm: tp.Optional[LayerNorm]
+    n_head: int = static()
+    n_kv_head: int = static()
+    dropout_rate: float = static(default=0.0)
+
+    @staticmethod
+    def init(key: KeyArray, cfg: ModelConfig) -> "Attention":
+        k1, k2 = jax.random.split(key)
+        c = cfg.head_dim
+        hkv = cfg.kv_heads
+        qkv_out = (cfg.n_head + 2 * hkv) * c
+        return Attention(
+            wqkv=Linear.init(k1, cfg.n_embd, qkv_out),
+            wo=Linear.init(k2, cfg.n_head * c, cfg.n_embd),
+            q_norm=LayerNorm.init(c, eps=1e-6) if cfg.qk_norm else None,
+            k_norm=LayerNorm.init(c, eps=1e-6) if cfg.qk_norm else None,
+            n_head=cfg.n_head,
+            n_kv_head=hkv,
+            dropout_rate=cfg.dropout,
+        )
+
+    def __call__(
+        self,
+        x: Array,  # [B, T, D]
+        sin,
+        cos,
+        *,
+        impl: str = "naive",
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+    ) -> Array:
+        b, t, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        adrop_key, pdrop_key = (
+            jax.random.split(key) if key is not None else (None, None)
+        )
+        with jax.named_scope("attention"):
+            qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
+            q = qkv[..., : h * c].reshape(b, t, h, c)
+            k = qkv[..., h * c : (h + hkv) * c].reshape(b, t, hkv, c)
+            v = qkv[..., (h + hkv) * c :].reshape(b, t, hkv, c)
+            if self.q_norm is not None:
+                q = self.q_norm(q)
+                k = self.k_norm(k)
+            # [B, H, T, C]
+            q = jnp.transpose(q, (0, 2, 1, 3))
+            k = jnp.transpose(k, (0, 2, 1, 3))
+            v = jnp.transpose(v, (0, 2, 1, 3))
+            q = apply_rotary(q, sin, cos)
+            k = apply_rotary(k, sin, cos)
+            q = shard_act(q, "batch", "heads", "seq", "head_dim")
+            k = shard_act(k, "batch", "kv_heads", "seq", "head_dim")
+            v = shard_act(v, "batch", "kv_heads", "seq", "head_dim")
+            out = attention(
+                q,
+                k,
+                v,
+                impl=impl,
+                causal=True,
+                dropout_rate=self.dropout_rate,
+                dropout_key=adrop_key,
+                deterministic=deterministic,
+            )
+            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+            out = self.wo(out)
+            out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
+            return shard_act(out, "batch", "seq", "embed")
+
+
+@module
+class MLP:
+    """GELU MLP (parity: model.py:17-31) or SwiGLU (Llama family)."""
+
+    w_up: Linear  # [D, F]
+    w_down: Linear  # [F, D]
+    w_gate: tp.Optional[Linear]  # [D, F] (SwiGLU only)
+    dropout_rate: float = static(default=0.0)
+
+    @staticmethod
+    def init(key: KeyArray, cfg: ModelConfig) -> "MLP":
+        k1, k2, k3 = jax.random.split(key, 3)
+        f = int(cfg.mlp_ratio * cfg.n_embd)
+        if cfg.mlp == "swiglu":
+            gate = Linear.init(k3, cfg.n_embd, f)
+        elif cfg.mlp == "gelu":
+            gate = None
+        else:
+            raise ValueError(f"unknown mlp kind {cfg.mlp!r}")
+        return MLP(
+            w_up=Linear.init(k1, cfg.n_embd, f),
+            w_down=Linear.init(k2, f, cfg.n_embd),
+            w_gate=gate,
+            dropout_rate=cfg.dropout,
+        )
+
+    def __call__(
+        self,
+        x: Array,
+        *,
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+    ) -> Array:
+        with jax.named_scope("mlp"):
+            up = self.w_up(x)
+            if self.w_gate is not None:
+                hidden = jax.nn.silu(self.w_gate(x)) * up
+            else:
+                hidden = jax.nn.gelu(up)
+            hidden = shard_act(hidden, "batch", "seq", "mlp")
+            out = self.w_down(hidden)
+            out = dropout(out, self.dropout_rate, key, deterministic)
+            return shard_act(out, "batch", "seq", "embed")
+
+
+@module
+class Block:
+    """Pre-norm residual block (parity: model.py:84-105)."""
+
+    attn: Attention
+    mlp: MLP
+    ln1: RMSNorm
+    ln2: RMSNorm
+
+    @staticmethod
+    def init(key: KeyArray, cfg: ModelConfig) -> "Block":
+        k1, k2 = jax.random.split(key)
+        return Block(
+            attn=Attention.init(k1, cfg),
+            mlp=MLP.init(k2, cfg),
+            # weightless block norms (model.py:94-95, layers.py:64-68)
+            ln1=RMSNorm.init(cfg.n_embd, use_weight=False),
+            ln2=RMSNorm.init(cfg.n_embd, use_weight=False),
+        )
+
+    def __call__(
+        self,
+        x: Array,
+        sin,
+        cos,
+        *,
+        impl: str = "naive",
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+    ) -> Array:
+        attn_key, mlp_key = (
+            jax.random.split(key) if key is not None else (None, None)
+        )
+        x = x + self.attn(
+            self.ln1(x), sin, cos, impl=impl, key=attn_key,
+            deterministic=deterministic,
+        )
+        x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
+        return x
+
+
+@module
+class GPT:
+    """The full model. ``blocks`` leaves carry a leading n_layer axis."""
+
+    wte: Embedding  # [V, D]
+    blocks: Block  # stacked: every leaf [L, ...]
+    ln_f: RMSNorm
+    lm_head: tp.Optional[Linear]  # [D, V]; None when tie_embeddings
+    config: ModelConfig = static()
+
+    @staticmethod
+    def init(key: KeyArray, cfg: ModelConfig) -> "GPT":
+        block_key, head_key = jax.random.split(key)
+        block_keys = jax.random.split(block_key, cfg.n_layer)
+        blocks = jax.vmap(lambda k: Block.init(k, cfg))(block_keys)
+        embed_std = 1 / math.sqrt(cfg.n_embd)
+        wte_wt = embed_std * jax.random.normal(
+            head_key, (cfg.vocab_size, cfg.n_embd), dtype=jnp.float32
+        )
+        if cfg.tie_embeddings:
+            lm_head = None  # reuse wte.weight.T at the head
+        else:
+            # reference semantics: same init array, independent params
+            # (model.py:134-138; SURVEY.md 2.3 "init-only tying")
+            lm_head = Linear(weight=wte_wt.T)
+        return GPT(
+            wte=Embedding(weight=wte_wt),
+            blocks=blocks,
+            ln_f=RMSNorm.init(cfg.n_embd, use_weight=False, eps=1e-5),
+            lm_head=lm_head,
+            config=cfg,
+        )
+
+    def __call__(
+        self,
+        tokens: Array,  # [B, T] int32
+        *,
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+        attn_impl: tp.Optional[str] = None,
+    ) -> Array:  # [B, T, V] logits in compute dtype
+        cfg = self.config
+        impl = attn_impl if attn_impl is not None else cfg.attn_impl
+        b, t = tokens.shape
+        assert t <= cfg.block_size, f"sequence {t} > block_size {cfg.block_size}"
+        sin, cos = rope_tables(cfg.head_dim, t, cfg.rope_base)
+
+        drop_key, scan_keys = (None, None)
+        if key is not None:
+            drop_key, block_key = jax.random.split(key)
+            scan_keys = jax.random.split(block_key, cfg.n_layer)
+
+        with jax.named_scope("gpt"):
+            h = self.wte(tokens)  # [B, T, D]
+            h = dropout(h, cfg.dropout, drop_key, deterministic)
+            h = shard_act(h, "batch", "seq", "embed")
+
+            def body(carry, layer):
+                block, k = layer
+                out = block(
+                    carry, sin, cos, impl=impl, key=k,
+                    deterministic=deterministic,
+                )
+                return out, None
+
+            if cfg.remat == "full":
+                # whole-block remat (parity: model.py:149-153)
+                body = jax.checkpoint(body)
+            elif cfg.remat == "dots":
+                body = jax.checkpoint(
+                    body,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )
+            elif cfg.remat != "none":
+                raise ValueError(f"unknown remat policy {cfg.remat!r}")
+
+            h, _ = jax.lax.scan(
+                body, h, (self.blocks, scan_keys), unroll=cfg.scan_unroll
+            )
+            h = self.ln_f(h)
+            head_w = (
+                self.wte.weight.T.astype(h.dtype)
+                if self.lm_head is None
+                else self.lm_head.weight.astype(h.dtype)
+            )
+            logits = h @ head_w  # [B, T, V]
+            return shard_act(logits, "batch", "seq", "vocab")
+
+
+def count_params(model: GPT) -> int:
+    """Non-embedding param count (parity: model.py:161-164 — subtract the
+    duplicated wte/lm_head array when untied)."""
+    from midgpt_tpu.pytree import count_params as _count
+
+    total = _count(model)
+    if model.lm_head is not None:
+        total -= model.lm_head.weight.size
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Parameter partition rules (replaces shard_gpt's size heuristic,
+# model.py:167-178). Specs are right-aligned against param rank, so the same
+# rule covers stacked [L, ...] scan params and unstacked ones.
+# ---------------------------------------------------------------------------
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+GPT_PARAM_RULES: tp.Sequence[tp.Tuple[str, P]] = (
+    # [V, D]: vocab over tensor, embed over fsdp
+    (r"wte/weight", P("tensor", "fsdp")),
+    # column-parallel: [L, D, (H+2Hkv)C] — in over fsdp, out over tensor
+    (r"attn/wqkv/weight", P("fsdp", "tensor")),
+    # row-parallel: [L, H*C, D] — in over tensor, out over fsdp
+    (r"attn/wo/weight", P("tensor", "fsdp")),
+    (r"attn/(q|k)_norm/weight", P()),
+    (r"mlp/w_(up|gate)/weight", P("fsdp", "tensor")),
+    (r"mlp/w_down/weight", P("tensor", "fsdp")),
+    (r"ln_f/weight|ln1/weight|ln2/weight", P()),
+    # [D, V]: embed over fsdp, vocab over tensor
+    (r"lm_head/weight", P("fsdp", "tensor")),
+)

@@ -1,0 +1,173 @@
+"""Primitive NN layers, batched-native.
+
+Capability parity with /root/reference/src/layers.py, redesigned TPU-first:
+every op works on full ``[..., T, D]`` batches (big MXU-friendly matmuls)
+instead of the reference's per-token modules vmapped by the caller
+(/root/reference/src/model.py:104). Weights are stored ``(in, out)`` so the
+forward is a plain ``x @ W`` contraction XLA maps straight onto the MXU.
+
+Numerics preserved exactly (SURVEY.md 2.3):
+- Linear: truncated-normal init in [-2, 2] scaled 1/sqrt(fan_in)
+  (layers.py:49-50), no bias.
+- RMSNorm: x * rsqrt(mean(x^2) + eps), optional learned scale
+  (layers.py:60-75); weightless for block and final norms.
+- QK-norm: mean-subtracting LayerNorm with weight, no bias, eps 1e-6
+  (model.py:52-53).
+- RoPE: GPT-J interleaved rotate-every-two, base 10000, tables precomputed
+  in NumPy at trace time so they constant-fold (layers.py:79-99).
+"""
+
+from __future__ import annotations
+
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from midgpt_tpu.pytree import module, static
+
+KeyArray = jax.Array
+Array = jax.Array
+
+
+@module
+class Embedding:
+    """Token-id -> vector gather (parity: layers.py:13-34)."""
+
+    weight: Array  # [V, D]
+
+    @staticmethod
+    def init(key: KeyArray, vocab_size: int, dim: int, std: float) -> "Embedding":
+        w = std * jax.random.normal(key, (vocab_size, dim), dtype=jnp.float32)
+        return Embedding(weight=w)
+
+    def __call__(self, tokens: Array) -> Array:  # [...] int -> [..., D]
+        with jax.named_scope("embedding"):
+            return jnp.take(self.weight, tokens, axis=0)
+
+
+@module
+class Linear:
+    """Bias-free linear, weight stored (in, out) (parity: layers.py:37-57,
+    transposed for x @ W)."""
+
+    weight: Array  # [in, out]
+
+    @staticmethod
+    def init(key: KeyArray, in_features: int, out_features: int) -> "Linear":
+        w = (1 / math.sqrt(in_features)) * jax.random.truncated_normal(
+            key, lower=-2, upper=2, shape=(in_features, out_features), dtype=jnp.float32
+        )
+        return Linear(weight=w)
+
+    def __call__(self, x: Array) -> Array:  # [..., in] -> [..., out]
+        with jax.named_scope("linear"):
+            return x @ self.weight
+
+
+@module
+class RMSNorm:
+    """x * rsqrt(mean(x^2, -1) + eps) [* weight] (parity: layers.py:60-75)."""
+
+    weight: tp.Optional[Array]  # [D] or None
+    eps: float = static(default=1e-6)
+
+    @staticmethod
+    def init(dim: int, use_weight: bool = False, eps: float = 1e-6) -> "RMSNorm":
+        w = jnp.ones((dim,), dtype=jnp.float32) if use_weight else None
+        return RMSNorm(weight=w, eps=eps)
+
+    def __call__(self, x: Array) -> Array:
+        with jax.named_scope("rmsnorm"):
+            out = x * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x), axis=-1, keepdims=True) + self.eps
+            )
+            if self.weight is not None:
+                out = out * self.weight.astype(out.dtype)
+            return out
+
+
+@module
+class LayerNorm:
+    """Mean-subtracting LayerNorm, learned scale, no bias. Used for per-head
+    QK normalization (parity: model.py:52-53, eqx.nn.LayerNorm(C, eps=1e-6,
+    use_weight=True, use_bias=False))."""
+
+    weight: Array  # [D]
+    eps: float = static(default=1e-6)
+
+    @staticmethod
+    def init(dim: int, eps: float = 1e-6) -> "LayerNorm":
+        return LayerNorm(weight=jnp.ones((dim,), dtype=jnp.float32), eps=eps)
+
+    def __call__(self, x: Array) -> Array:
+        with jax.named_scope("layernorm"):
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            centered = x - mean
+            var = jnp.mean(jnp.square(centered), axis=-1, keepdims=True)
+            out = centered * jax.lax.rsqrt(var + self.eps)
+            return out * self.weight.astype(out.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings (interleaved / GPT-J style)
+# ---------------------------------------------------------------------------
+
+
+def rope_tables(
+    head_dim: int, seq_len: int, base: float = 10000.0
+) -> tp.Tuple[np.ndarray, np.ndarray]:
+    """Precompute sin/cos tables [T, head_dim//2] in NumPy at trace time so
+    XLA constant-folds them (parity: layers.py:79-82)."""
+    inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2) / head_dim))
+    angles = np.einsum("i,j->ij", np.arange(seq_len), inv_freq)
+    return np.sin(angles), np.cos(angles)
+
+
+def rotate_every_two(x: Array) -> Array:
+    """[a b c d] -> [-b a -d c] (parity: layers.py:85-89)."""
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    y = jnp.stack((-x2, x1), axis=-1)
+    return jnp.reshape(y, x.shape)
+
+
+def _duplicate_interleaved(t: Array) -> Array:
+    """[..., D/2] -> [..., D] duplicating each column across even/odd lanes."""
+    y = jnp.stack((t, t), axis=-1)
+    return jnp.reshape(y, t.shape[:-1] + (t.shape[-1] * 2,))
+
+
+def apply_rotary(
+    x: Array, sin: tp.Union[Array, np.ndarray], cos: tp.Union[Array, np.ndarray]
+) -> Array:
+    """Apply interleaved RoPE. ``x``: [..., T, C]; sin/cos: [T, C//2]
+    (parity: layers.py:92-99)."""
+    with jax.named_scope("rope"):
+        sin = jnp.asarray(sin, dtype=x.dtype)
+        cos = jnp.asarray(cos, dtype=x.dtype)
+        sin_full = _duplicate_interleaved(sin)
+        cos_full = _duplicate_interleaved(cos)
+        return x * cos_full + rotate_every_two(x) * sin_full
+
+
+# ---------------------------------------------------------------------------
+# Dropout (functional)
+# ---------------------------------------------------------------------------
+
+
+def dropout(
+    x: Array,
+    rate: float,
+    key: tp.Optional[KeyArray],
+    deterministic: bool,
+) -> Array:
+    """Inverted dropout; no-op when deterministic or rate == 0."""
+    if deterministic or rate == 0.0:
+        return x
+    assert key is not None, "dropout in training mode requires a PRNG key"
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

@@ -1,0 +1,3 @@
+from midgpt_tpu.ops.attention import attention, causal_mask, naive_attention
+
+__all__ = ["attention", "causal_mask", "naive_attention"]

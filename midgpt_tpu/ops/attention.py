@@ -1,0 +1,121 @@
+"""Attention ops: reference (naive) implementation + impl dispatch.
+
+The naive path is the correctness oracle, numerically mirroring
+/root/reference/src/model.py:71-79: scores computed from bf16 Q/K, causal
+mask applied as -inf BEFORE scaling, softmax in float32 with the 1/sqrt(C)
+scale folded into the softmax argument, result cast back to the compute
+dtype. O(T^2) memory — the Pallas flash kernel (midgpt_tpu.ops.flash)
+replaces it on TPU; ring attention (midgpt_tpu.parallel.ring) replaces it
+under sequence parallelism.
+
+Layout: [B, H, T, C] (batch, heads, time, head_dim). GQA is supported by
+passing fewer KV heads; the naive path broadcasts via reshape (no repeat
+materialization).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def causal_mask(t: int, dtype=jnp.float32) -> Array:
+    """[T, T] additive mask: 0 on/below diagonal, -inf above."""
+    mask = jnp.tril(jnp.ones((t, t), dtype=jnp.bool_))
+    return jnp.where(mask, 0.0, -jnp.inf).astype(dtype)
+
+
+def naive_attention(
+    q: Array,  # [B, H, T, C]
+    k: Array,  # [B, Hkv, T, C]
+    v: Array,  # [B, Hkv, T, C]
+    *,
+    causal: bool = True,
+    dropout_rate: float = 0.0,
+    dropout_key: tp.Optional[Array] = None,
+    deterministic: bool = True,
+) -> Array:
+    """Reference-math attention (parity: model.py:71-79)."""
+    b, h, t, c = q.shape
+    hkv = k.shape[1]
+    assert h % hkv == 0, f"n_head {h} not divisible by n_kv_head {hkv}"
+    groups = h // hkv
+
+    with jax.named_scope("naive_attention"):
+        qg = q.reshape(b, hkv, groups, t, c)
+        # scores in f32 accumulate (MXU native bf16 in / f32 out)
+        scores = jnp.einsum(
+            "bkgqc,bkjc->bkgqj", qg, k, preferred_element_type=jnp.float32
+        )
+        if causal:
+            scores = scores + causal_mask(t)
+        # scale inside the f32 softmax argument (model.py:74-77)
+        scale = 1.0 / jnp.sqrt(c).astype(jnp.float32)
+        probs = jax.nn.softmax(scores * scale, axis=-1)
+        if dropout_rate > 0.0 and not deterministic:
+            assert dropout_key is not None
+            keep = 1.0 - dropout_rate
+            mask = jax.random.bernoulli(dropout_key, p=keep, shape=probs.shape)
+            probs = jnp.where(mask, probs / keep, 0.0)
+        probs = probs.astype(v.dtype)
+        out = jnp.einsum("bkgqj,bkjc->bkgqc", probs, v)
+        return out.reshape(b, h, t, c)
+
+
+def attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    impl: str = "auto",
+    causal: bool = True,
+    dropout_rate: float = 0.0,
+    dropout_key: tp.Optional[Array] = None,
+    deterministic: bool = True,
+) -> Array:
+    """Dispatch between implementations.
+
+    impl:
+      auto  - flash on TPU when shapes allow and no attention dropout,
+              else naive
+      naive - reference O(T^2) math (oracle)
+      flash - Pallas blockwise online-softmax kernel
+    """
+    if impl == "auto":
+        on_tpu = any(d.platform != "cpu" for d in jax.devices())
+        use_flash = (
+            on_tpu
+            and (dropout_rate == 0.0 or deterministic)
+            and q.shape[2] >= 128
+            and q.shape[2] % 128 == 0
+        )
+        impl = "flash" if use_flash else "naive"
+
+    if impl == "naive":
+        return naive_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            dropout_rate=dropout_rate,
+            dropout_key=dropout_key,
+            deterministic=deterministic,
+        )
+    if impl == "flash":
+        from midgpt_tpu.ops.flash import flash_attention
+
+        assert dropout_rate == 0.0 or deterministic, (
+            "flash attention does not support attention dropout; use naive"
+        )
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        raise ValueError(
+            "ring attention runs under shard_map; use "
+            "midgpt_tpu.parallel.ring.ring_attention via the training step, "
+            "not the per-device dispatcher"
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

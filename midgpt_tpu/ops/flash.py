@@ -1,0 +1,389 @@
+"""Pallas TPU flash attention (causal, GQA-aware, custom VJP).
+
+Replaces the reference's naive O(T^2)-memory attention
+(/root/reference/src/model.py:71-79) with a blockwise online-softmax kernel:
+scores never materialize in HBM; softmax runs in float32 with the 1/sqrt(C)
+scale folded into the softmax argument, exactly mirroring the reference
+numerics (SURVEY.md 2.3).
+
+Layout [B, H, T, C]; K/V may carry fewer (grouped) heads — the kernel grid
+maps each Q head to its KV group, so tensor-parallel head sharding composes
+(each shard sees a smaller H).
+
+Forward residual is the standard (out, logsumexp) pair; backward runs two
+kernels (dQ over Q blocks; dK/dV over KV blocks) plus a trivial elementwise
+delta precomputation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30  # avoids NaN from (-inf) - (-inf) in fully-masked rows
+
+
+def _block_sizes(t: int, bq: int, bk: int) -> tp.Tuple[int, int]:
+    bq = min(bq, t)
+    bk = min(bk, t)
+    assert t % bq == 0 and t % bk == 0, (
+        f"seq len {t} must be a multiple of block sizes ({bq}, {bk})"
+    )
+    return bq, bk
+
+
+def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
+    """Boolean [bq, bk] mask for the (iq, ik) block pair: True = visible."""
+    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    last_k = iq if causal else nk - 1
+    run = (ik <= iq) if causal else (ik >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]  # [bq, C]
+        k = k_ref[0, 0]  # [bk, C]
+        v = v_ref[0, 0]  # [bk, C]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        z = s * scale
+        if causal:
+            # only the diagonal block needs the element-level mask
+            z = jnp.where(
+                jnp.logical_or(ik != iq, _causal_mask_block(iq, ik, bq, bk)),
+                z,
+                _NEG_INF,
+            )
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(z, axis=1, keepdims=True)  # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)  # [bq, 1]
+        p = jnp.exp(z - m_next)  # [bq, bk] f32
+        l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_bcast = jax.lax.broadcast_in_dim(m_next, m_ref.shape, (0, 1))
+        l_bcast = jax.lax.broadcast_in_dim(l_next, l_ref.shape, (0, 1))
+        m_ref[:] = m_bcast
+        l_ref[:] = l_bcast
+
+    @pl.when(ik == last_k)
+    def _finalize():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        # causal rows always have >= 1 visible key, so l > 0
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_forward(
+    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int
+) -> tp.Tuple[Array, Array]:
+    b, h, t, c = q.shape
+    hkv, s = k.shape[1], k.shape[2]
+    assert s == t, "self-attention only (use decode path for caches)"
+    groups = h // hkv
+    bq, bk = _block_sizes(t, bq, bk)
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / math.sqrt(c)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, c), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik <= iq) if causal else (ik >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # [bq, 1] f32
+        delta = delta_ref[0, 0]  # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        z = s * scale
+        if causal:
+            z = jnp.where(
+                jnp.logical_or(ik != iq, _causal_mask_block(iq, ik, bq, bk)),
+                z,
+                _NEG_INF,
+            )
+        p = jnp.exp(z - lse)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    last_k = iq if causal else nk - 1
+
+    @pl.when(ik == last_k)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, bq: int, bk: int, nq: int,
+):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == (ik if causal else 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (iq >= ik) if causal else (iq >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]  # [bq, C]
+        k = k_ref[0, 0]  # [bk, C]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]  # [bq, C]
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        z = s * scale
+        if causal:
+            z = jnp.where(
+                jnp.logical_or(ik != iq, _causal_mask_block(iq, ik, bq, bk)),
+                z,
+                _NEG_INF,
+            )
+        p = jnp.exp(z - lse)  # [bq, bk]
+        # dv += p^T @ do  -> [bk, C]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = p * (dp - delta) * scale  # [bq, bk]
+        # dk += ds^T @ q -> [bk, C]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
+    *, causal: bool, bq: int, bk: int,
+) -> tp.Tuple[Array, Array, Array]:
+    b, h, t, c = q.shape
+    hkv = k.shape[1]
+    groups = h // hkv
+    bq, bk = _block_sizes(t, bq, bk)
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / math.sqrt(c)
+
+    # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B, H, T, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
+            ),
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, c), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV per Q-head (summed over GQA groups afterwards)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_ // groups, ik, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_ // groups, ik, 0)
+            ),
+            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, c), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, c), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, c), jnp.float32),
+            pltpu.VMEM((bk, c), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+
+    if groups > 1:
+        dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> Array:
+    out, _ = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+    return out
+
+
+def _vjp_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(causal, block_q, block_k, residuals, do):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do, causal=causal, bq=block_q, bk=block_k
+    )
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def flash_attention_reference(q, k, v, causal=True):
+    """jnp oracle with identical math, for tests."""
+    from midgpt_tpu.ops.attention import naive_attention
+
+    return naive_attention(q, k, v, causal=causal)

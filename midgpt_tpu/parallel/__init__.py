@@ -1,0 +1,24 @@
+from midgpt_tpu.parallel.mesh import AXIS_NAMES, BATCH_AXES, create_mesh, single_device_mesh
+from midgpt_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_RULES,
+    axis_rules,
+    constrain_params,
+    make_global_array,
+    param_shardings,
+    replicate,
+    shard_act,
+)
+
+__all__ = [
+    "AXIS_NAMES",
+    "BATCH_AXES",
+    "create_mesh",
+    "single_device_mesh",
+    "DEFAULT_LOGICAL_RULES",
+    "axis_rules",
+    "constrain_params",
+    "make_global_array",
+    "param_shardings",
+    "replicate",
+    "shard_act",
+]

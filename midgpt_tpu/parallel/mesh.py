@@ -1,0 +1,66 @@
+"""Device mesh construction.
+
+Replaces the reference's hardcoded ``(n_devices // 8, 8)`` 2-D mesh
+(/root/reference/src/train.py:130) with an explicit 4-axis mesh
+``('replica', 'fsdp', 'sequence', 'tensor')`` sized from ``MeshConfig``.
+
+- Single slice: ``mesh_utils.create_device_mesh`` lays axes out so the
+  innermost (tensor) axis rides the fastest ICI links.
+- Multi-slice (num_slices > 1): ``create_hybrid_device_mesh`` puts the
+  outermost axes (replica) across DCN and the rest within each slice's ICI
+  domain — DP-only over DCN per SURVEY.md 2.6.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from midgpt_tpu.config import MeshConfig
+
+AXIS_NAMES = ("replica", "fsdp", "sequence", "tensor")
+
+# mesh axes a global batch is sharded over (data-parallel axes)
+BATCH_AXES = ("replica", "fsdp")
+
+
+def create_mesh(
+    cfg: MeshConfig, devices: tp.Optional[tp.Sequence[jax.Device]] = None
+) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = cfg.sizes(len(devices))
+
+    if cfg.num_slices > 1:
+        assert sizes[0] % cfg.num_slices == 0, (
+            f"replica axis {sizes[0]} must be a multiple of num_slices "
+            f"{cfg.num_slices} (DP-only over DCN)"
+        )
+        dcn_parallelism = (cfg.num_slices, 1, 1, 1)
+        ici_parallelism = (sizes[0] // cfg.num_slices,) + sizes[1:]
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_parallelism,
+            dcn_parallelism,
+            devices=devices,
+            allow_split_physical_axes=True,
+        )
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                sizes, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            # CPU-simulated or irregular topologies: plain reshape
+            device_array = np.asarray(devices).reshape(sizes)
+
+    return Mesh(device_array, AXIS_NAMES)
+
+
+def single_device_mesh(device: tp.Optional[jax.Device] = None) -> Mesh:
+    """Degenerate 1-device mesh (all axes size 1) so the same sharded code
+    path runs on one chip or CPU."""
+    device = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXIS_NAMES)

@@ -1,0 +1,175 @@
+"""Declarative sharding: logical-axis activation constraints + a param
+partition-rule table.
+
+Replaces the reference's size-gated last-dim heuristic ``shard_gpt``
+(/root/reference/src/model.py:167-178) and the big_vision ``reshard`` /
+``get_shard_fn`` host glue (/root/reference/src/sharding.py), redesigned:
+
+- Activations: model code tags intermediate arrays with *logical* axis names
+  (``shard_act(x, 'batch', 'seq', 'embed')``); a context-scoped rule table
+  maps logical names to mesh axes. No mesh leaks into model code.
+- Parameters: a list of ``(path-regex, PartitionSpec)`` rules resolved
+  against pytree paths gives every param an explicit NamedSharding —
+  FSDP x TP is a rule-table entry, not a size heuristic.
+- Host->device feed: ``make_global_array`` assembles per-process batches
+  into one global jax.Array (parity: sharding.py:33-42).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from midgpt_tpu.pytree import tree_paths
+
+Array = jax.Array
+
+# logical axis name -> mesh axis (str | tuple | None)
+LogicalRules = tp.Mapping[str, tp.Union[str, tp.Tuple[str, ...], None]]
+
+# Default logical->mesh mapping. 'batch' shards over both DP axes (the
+# reference sharded batch over ('replica', 'data'), train.py:105).
+DEFAULT_LOGICAL_RULES: LogicalRules = {
+    "batch": ("replica", "fsdp"),
+    "seq": "sequence",
+    "embed": None,
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "mlp": "tensor",
+    "vocab": "tensor",
+    "layers": None,
+}
+
+
+class _ShardingContext(threading.local):
+    def __init__(self):
+        self.mesh: tp.Optional[Mesh] = None
+        self.rules: tp.Optional[LogicalRules] = None
+
+
+_CTX = _ShardingContext()
+
+
+class axis_rules:
+    """Context manager activating activation-sharding constraints.
+
+    with axis_rules(mesh): ... # default rules
+    with axis_rules(mesh, rules): ...
+    with axis_rules(None): ... # explicit no-op scope
+    """
+
+    def __init__(self, mesh: tp.Optional[Mesh], rules: tp.Optional[LogicalRules] = None):
+        self._new = (mesh, dict(rules) if rules is not None else dict(DEFAULT_LOGICAL_RULES))
+
+    def __enter__(self):
+        self._old = (_CTX.mesh, _CTX.rules)
+        _CTX.mesh, _CTX.rules = self._new
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.mesh, _CTX.rules = self._old
+        return False
+
+
+def logical_to_spec(logical_axes: tp.Sequence[tp.Optional[str]],
+                    rules: tp.Optional[LogicalRules] = None) -> P:
+    if rules is None:
+        rules = _CTX.rules if _CTX.rules is not None else DEFAULT_LOGICAL_RULES
+    for a in logical_axes:
+        assert a is None or a in rules, (
+            f"unknown logical axis {a!r}; rule table has {sorted(rules)}"
+        )
+    return P(*[rules.get(a) if a is not None else None for a in logical_axes])
+
+
+def shard_act(x: Array, *logical_axes: tp.Optional[str]) -> Array:
+    """Constrain an activation's sharding by logical axis names.
+
+    No-op outside an ``axis_rules`` scope (single-device tests, sampling).
+    """
+    if _CTX.mesh is None:
+        return x
+    assert len(logical_axes) == x.ndim, (
+        f"{len(logical_axes)} axes for rank-{x.ndim} array"
+    )
+    spec = logical_to_spec(logical_axes)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(_CTX.mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# Parameter partition rules
+# ---------------------------------------------------------------------------
+
+ParamRules = tp.Sequence[tp.Tuple[str, P]]
+
+
+def match_param_spec(path: str, rules: ParamRules) -> P:
+    """First rule whose regex matches (re.search) wins; default replicated."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def param_shardings(mesh: Mesh, tree: tp.Any, rules: ParamRules) -> tp.Any:
+    """Pytree of NamedShardings matching ``tree``, resolved from ``rules``.
+
+    Specs may have fewer entries than the array rank; they are right-padded
+    with None (replicated leading axes) — this is how one rule covers both a
+    stacked ``[L, D, F]`` scan param and an unstacked ``[D, F]`` one.
+    """
+    paths = tree_paths(tree)
+    shardings = []
+    for path, leaf in paths:
+        spec = match_param_spec(path, rules)
+        ndim = getattr(leaf, "ndim", 0)
+        entries = list(spec)
+        assert len(entries) <= ndim, (
+            f"spec {spec} has more axes than rank-{ndim} param at {path}"
+        )
+        entries = [None] * (ndim - len(entries)) + entries
+        shardings.append(NamedSharding(mesh, P(*entries)))
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def constrain_params(tree: tp.Any, mesh: Mesh, rules: ParamRules) -> tp.Any:
+    """with_sharding_constraint over a whole param tree (used inside jit on
+    grads so accumulated grads stay sharded — parity: train.py:87)."""
+    shardings = param_shardings(mesh, tree, rules)
+    return jax.tree.map(jax.lax.with_sharding_constraint, tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device glue (multi-process data feed)
+# ---------------------------------------------------------------------------
+
+
+def make_global_array(
+    local_batch: np.ndarray, mesh: Mesh, spec: P
+) -> Array:
+    """Assemble per-process host batches into one global jax.Array.
+
+    Parity: /root/reference/src/sharding.py:33-42 (get_shard_fn), generalized
+    to any PartitionSpec: each process holds 1/num_processes of the global
+    batch along axis 0; jax.make_array_from_process_local_data computes the
+    local->global mapping from the sharding itself.
+    """
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def replicate(tree: tp.Any, mesh: Mesh) -> tp.Any:
+    """Fully replicate host-side leaves onto the mesh (parity:
+    sharding.py:15-30 reshard with replicated sharding)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.asarray(x), sharding), tree
+    )

@@ -1,0 +1,92 @@
+"""Pytree-dataclass module system.
+
+TPU-first replacement for the reference's Equinox module idiom
+(/root/reference/src/layers.py:13-99): a module is a frozen dataclass
+registered with ``jax.tree_util.register_dataclass``. Array-valued fields are
+pytree leaves (parameters / sub-modules); fields declared with ``static()``
+are auxiliary data baked into the treedef (hashable, trace-time constants).
+
+This gives the same "params are just a pytree" property the reference gets
+from ``eqx.partition`` (/root/reference/src/train.py:82) without a partition /
+combine step: the whole model is directly jit-able, vmap-able and shardable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+_T = tp.TypeVar("_T")
+
+
+def static(default: tp.Any = dataclasses.MISSING, **kwargs) -> tp.Any:
+    """Declare a dataclass field as static (treedef aux data, not a leaf)."""
+    metadata = dict(kwargs.pop("metadata", {}) or {})
+    metadata["pytree_static"] = True
+    if default is not dataclasses.MISSING:
+        kwargs["default"] = default
+    return dataclasses.field(metadata=metadata, **kwargs)
+
+
+def module(cls: tp.Type[_T]) -> tp.Type[_T]:
+    """Class decorator: frozen dataclass + pytree registration."""
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    data_fields, meta_fields = [], []
+    for f in dataclasses.fields(cls):
+        if f.metadata.get("pytree_static", False):
+            meta_fields.append(f.name)
+        else:
+            data_fields.append(f.name)
+    jax.tree_util.register_dataclass(
+        cls, data_fields=data_fields, meta_fields=meta_fields
+    )
+    return cls
+
+
+def is_array(x: tp.Any) -> bool:
+    return isinstance(x, (jax.Array,)) or hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def cast_floating(tree: tp.Any, dtype: tp.Any) -> tp.Any:
+    """Cast all inexact (floating) array leaves to ``dtype``.
+
+    Mixed-precision boundary, equivalent of ``cast_pytree``
+    (/root/reference/src/train.py:47-53): params live in float32, compute
+    runs in bfloat16.
+    """
+
+    def _cast(x):
+        if is_array(x) and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+            return jnp.asarray(x, dtype=dtype)
+        return x
+
+    return jax.tree.map(_cast, tree)
+
+
+def count_params(tree: tp.Any) -> int:
+    """Total number of array elements in the tree."""
+    return sum(
+        x.size for x in jax.tree.leaves(tree) if is_array(x)
+    )
+
+
+def tree_paths(tree: tp.Any) -> tp.List[tp.Tuple[str, tp.Any]]:
+    """Flatten a pytree into ("a/b/c", leaf) pairs using field/key names."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, leaf in flat:
+        parts = []
+        for k in keypath:
+            if isinstance(k, jax.tree_util.GetAttrKey):
+                parts.append(k.name)
+            elif isinstance(k, jax.tree_util.DictKey):
+                parts.append(str(k.key))
+            elif isinstance(k, jax.tree_util.SequenceKey):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts), leaf))
+    return out

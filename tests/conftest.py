@@ -1,0 +1,27 @@
+"""Test environment: force an 8-device CPU platform BEFORE jax import so
+multi-device sharding (DP/FSDP/SP/TP) is exercised without TPU hardware
+(SURVEY.md 4: the reference's mesh code silently assumes >= 8 devices)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # the env pins JAX_PLATFORMS=axon
+jax.config.update("jax_threefry_partitionable", True)  # (train.py:16)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(MeshConfig(replica=1, fsdp=2, sequence=2, tensor=2))

@@ -1,0 +1,87 @@
+"""Flash-attention kernel vs the naive oracle, on CPU via the Pallas
+interpreter. Real-TPU parity is exercised by bench.py / tpu smoke runs."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.ops.attention import naive_attention
+
+# interpret-mode pallas on CPU
+import midgpt_tpu.ops.flash as flash_mod
+from jax.experimental import pallas as pl
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    orig = pl.pallas_call
+    monkeypatch.setattr(
+        pl, "pallas_call", functools.partial(orig, interpret=True)
+    )
+    yield
+
+
+def _rand_qkv(key, b, h, hkv, t, c, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, h, t, c), dtype)
+    k = jax.random.normal(k2, (b, hkv, t, c), dtype)
+    v = jax.random.normal(k3, (b, hkv, t, c), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_naive(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 2, 2, 256, 32)
+    out = flash_mod.flash_attention(q, k, v, causal, 128, 128)
+    ref = naive_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_forward_gqa():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 4, 2, 256, 32)
+    out = flash_mod.flash_attention(q, k, v, True, 128, 128)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grad_matches_naive():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 2, 2, 256, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mod.flash_attention(q, k, v, True, 128, 128) ** 2)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_grad_gqa():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 4, 2, 128, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mod.flash_attention(q, k, v, True, 128, 128) ** 2)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_rejects_ragged_seq():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 1, 1, 192, 32)
+    with pytest.raises(AssertionError):
+        flash_mod.flash_attention(q, k, v, True, 128, 128)

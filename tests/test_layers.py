@@ -1,0 +1,108 @@
+"""Layer-level unit tests, incl. the reference's rotary shift-invariance
+property test (/root/reference/scripts/test_rotary.py:11-32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.models.layers import (
+    Embedding,
+    LayerNorm,
+    Linear,
+    RMSNorm,
+    apply_rotary,
+    dropout,
+    rope_tables,
+    rotate_every_two,
+)
+
+
+def test_linear_init_and_apply():
+    key = jax.random.PRNGKey(0)
+    lin = Linear.init(key, 32, 64)
+    assert lin.weight.shape == (32, 64)
+    # truncated normal scaled 1/sqrt(fan_in): bounded by 2/sqrt(32)
+    assert np.abs(lin.weight).max() <= 2 / np.sqrt(32) + 1e-6
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 7, 32))
+    y = lin(x)
+    assert y.shape == (4, 7, 64)
+    np.testing.assert_allclose(y[0, 0], x[0, 0] @ lin.weight, rtol=1e-5)
+
+
+def test_embedding_gather():
+    emb = Embedding.init(jax.random.PRNGKey(0), 100, 16, std=0.1)
+    tok = jnp.array([[1, 2], [3, 99]])
+    out = emb(tok)
+    assert out.shape == (2, 2, 16)
+    np.testing.assert_array_equal(out[1, 1], emb.weight[99])
+
+
+def test_rmsnorm_matches_formula():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 16))
+    norm = RMSNorm.init(16, use_weight=False)
+    out = norm(x)
+    expected = x * (1.0 / np.sqrt(np.mean(np.asarray(x) ** 2, -1, keepdims=True) + 1e-6))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+    # weightless => no params
+    assert norm.weight is None
+
+
+def test_layernorm_mean_subtracting():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8)) * 3 + 5
+    ln = LayerNorm.init(8)
+    out = np.asarray(ln(x))
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+def test_rotate_every_two():
+    x = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(
+        np.asarray(rotate_every_two(x)), [[-2.0, 1.0, -4.0, 3.0]]
+    )
+
+
+def test_rotary_shift_invariance():
+    """Attention scores depend only on relative position (parity:
+    scripts/test_rotary.py:11-32)."""
+    key = jax.random.PRNGKey(0)
+    t, c, shift = 32, 16, 5
+    q = jax.random.normal(key, (1, 1, t, c))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, t, c))
+    sin, cos = rope_tables(c, t)
+    qr = apply_rotary(q, sin, cos)
+    kr = apply_rotary(k, sin, cos)
+    scores = np.asarray(qr @ jnp.swapaxes(kr, -1, -2))[0, 0]
+
+    # shift q, k along T by `shift`: scores in the overlap must match
+    q_s = jnp.roll(q, shift, axis=2)
+    k_s = jnp.roll(k, shift, axis=2)
+    qr_s = apply_rotary(q_s, sin, cos)
+    kr_s = apply_rotary(k_s, sin, cos)
+    scores_s = np.asarray(qr_s @ jnp.swapaxes(kr_s, -1, -2))[0, 0]
+
+    np.testing.assert_allclose(
+        scores_s[shift:, shift:], scores[:-shift, :-shift], atol=1e-4
+    )
+
+
+def test_rope_tables_constant_fold():
+    sin, cos = rope_tables(8, 16)
+    assert isinstance(sin, np.ndarray) and sin.shape == (16, 4)
+    # base angle progression
+    np.testing.assert_allclose(cos[0], 1.0)
+    np.testing.assert_allclose(sin[0], 0.0)
+
+
+def test_dropout_modes():
+    x = jnp.ones((100, 100))
+    # deterministic => identity
+    np.testing.assert_array_equal(np.asarray(dropout(x, 0.5, None, True)), np.asarray(x))
+    out = np.asarray(dropout(x, 0.5, jax.random.PRNGKey(0), False))
+    frac_zero = (out == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    # survivors scaled by 1/keep
+    assert np.allclose(out[out != 0], 2.0)
+    with pytest.raises(AssertionError):
+        dropout(x, 0.5, None, False)
