@@ -1,0 +1,96 @@
+"""Benchmark: training throughput + MFU on real TPU hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline: GPT-2-small (124M, openwebtext config) training MFU on the
+available chip(s), compared against the reference's published 47.8% MFU
+(1.5B on v3-128, /root/reference/README.md:55 — the only published
+efficiency number; see BASELINE.md)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
+
+
+def main() -> None:
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.config import MeshConfig, get_config
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+    from midgpt_tpu.utils.metrics import flops_per_token, mfu
+
+    n_dev = jax.device_count()
+    cfg = get_config("openwebtext")
+    # one microbatch sized for a single chip; flash attention on
+    batch = 16 * n_dev
+    cfg = dataclasses.replace(
+        cfg,
+        batch_size=batch,
+        g_accum_iters=1,
+        model=dataclasses.replace(cfg.model, attn_impl="auto", remat="full"),
+        mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
+    )
+
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+    train_step = make_train_step(cfg, tx, mesh)
+
+    t = cfg.model.block_size
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.model.vocab_size, size=(1, batch, t), dtype=np.int32)
+    y = rng.integers(0, cfg.model.vocab_size, size=(1, batch, t), dtype=np.int32)
+    spec = P(None, ("replica", "fsdp"), "sequence")
+    xg = make_global_array(x, mesh, spec)
+    yg = make_global_array(y, mesh, spec)
+    key = jax.random.PRNGKey(1)
+
+    def chain(state, n):
+        # n chained steps + ONE host sync. Under the axon relay a host
+        # transfer costs ~70ms RTT and block_until_ready alone is
+        # unreliable, so true step time = delta between chain lengths.
+        start = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            state, loss = train_step(state, xg, yg, key)
+        _ = float(loss)
+        return time.perf_counter() - start, state
+
+    _, state = chain(state, 1)  # compile
+    t_1, state = chain(state, 1)  # RTT + 1 step
+    n_steps = 10
+    t_n, state = chain(state, n_steps + 1)
+    elapsed = t_n - t_1
+
+    tokens_per_sec = batch * t * n_steps / elapsed
+    achieved_mfu = mfu(tokens_per_sec, cfg.model, n_dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "openwebtext_124m_train_mfu",
+                "value": round(achieved_mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(achieved_mfu / BASELINE_MFU, 4),
+                "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 1),
+                "step_ms": round(1e3 * elapsed / n_steps, 1),
+                "device": jax.devices()[0].device_kind,
+                "n_devices": n_dev,
+                "model_flops_per_token": flops_per_token(cfg.model),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

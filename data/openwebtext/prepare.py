@@ -1,0 +1,63 @@
+"""Prepare OpenWebText as GPT-2 BPE uint16 token streams (parity:
+/root/reference/data/openwebtext/prepare.py): HF load_dataset, 0.05% val
+split (seed 2357), tiktoken GPT-2 encode_ordinary + EOT append, parallel
+map, concat to memmapped train.bin/val.bin (~9.04B / ~4.4M tokens).
+
+Requires network + disk; run on a CPU host, not the TPU workers."""
+
+import argparse
+import os
+
+import numpy as np
+
+NUM_PROC = max(os.cpu_count() // 2, 1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out_dir", default=os.path.dirname(__file__) or ".")
+    ap.add_argument("--num_proc", type=int, default=NUM_PROC)
+    args = ap.parse_args()
+
+    import tiktoken
+    from datasets import load_dataset
+
+    enc = tiktoken.get_encoding("gpt2")
+
+    dataset = load_dataset("openwebtext", num_proc=args.num_proc)
+    split = dataset["train"].train_test_split(
+        test_size=0.0005, seed=2357, shuffle=True
+    )
+    split["val"] = split.pop("test")
+
+    def process(example):
+        ids = enc.encode_ordinary(example["text"])
+        ids.append(enc.eot_token)
+        return {"ids": ids, "len": len(ids)}
+
+    tokenized = split.map(
+        process,
+        remove_columns=["text"],
+        desc="tokenizing",
+        num_proc=args.num_proc,
+    )
+
+    for name, dset in tokenized.items():
+        total = np.sum(dset["len"], dtype=np.uint64)
+        path = os.path.join(args.out_dir, f"{name}.bin")
+        arr = np.memmap(path, dtype=np.uint16, mode="w+", shape=(int(total),))
+        idx = 0
+        n_shards = 1024
+        for shard_idx in range(n_shards):
+            shard = dset.shard(
+                num_shards=n_shards, index=shard_idx, contiguous=True
+            ).with_format("numpy")
+            batch = np.concatenate(shard["ids"])
+            arr[idx : idx + len(batch)] = batch
+            idx += len(batch)
+        arr.flush()
+        print(f"{name}: {int(total):,} tokens -> {path}")
+
+
+if __name__ == "__main__":
+    main()

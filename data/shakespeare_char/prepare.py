@@ -1,0 +1,79 @@
+"""Prepare the char-level tinyshakespeare dataset (parity:
+/root/reference/data/shakespeare_char/prepare.py): download input.txt,
+build the char vocab, 90/10 split, write train.bin/val.bin (uint16) +
+meta.pkl with stoi/itos.
+
+Offline environments: pass --input=<path> to use a local text file, or
+--synthetic to generate a deterministic synthetic corpus (for smoke runs
+only — golden val-loss numbers require the real dataset)."""
+
+import argparse
+import os
+import pickle
+
+import numpy as np
+
+URL = "https://raw.githubusercontent.com/karpathy/char-rnn/master/data/tinyshakespeare/input.txt"
+
+
+def synthetic_corpus(n_chars: int = 1_000_000, seed: int = 0) -> str:
+    """Deterministic char-level corpus with word/sentence structure —
+    enough statistical signal for a tiny model to fit, zero downloads."""
+    rng = np.random.default_rng(seed)
+    words = [
+        "the", "lord", "king", "and", "to", "of", "thou", "thy", "with",
+        "love", "death", "night", "day", "sword", "crown", "blood", "heart",
+        "speak", "come", "good", "my", "what", "shall", "is", "not", "that",
+    ]
+    names = ["ROMEO", "JULIET", "HAMLET", "MACBETH", "OTHELLO", "KING LEAR"]
+    parts = []
+    total = 0
+    while total < n_chars:
+        name = names[rng.integers(len(names))]
+        n_words = int(rng.integers(4, 12))
+        sent = " ".join(words[rng.integers(len(words))] for _ in range(n_words))
+        line = f"{name}:\n{sent.capitalize()}.\n\n"
+        parts.append(line)
+        total += len(line)
+    return "".join(parts)[:n_chars]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default=None, help="local input.txt path")
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--out_dir", default=os.path.dirname(__file__) or ".")
+    args = ap.parse_args()
+
+    if args.synthetic:
+        text = synthetic_corpus()
+    elif args.input:
+        with open(args.input) as f:
+            text = f.read()
+    else:
+        import requests
+
+        path = os.path.join(args.out_dir, "input.txt")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write(requests.get(URL, timeout=60).text)
+        with open(path) as f:
+            text = f.read()
+
+    chars = sorted(set(text))
+    stoi = {ch: i for i, ch in enumerate(chars)}
+    itos = {i: ch for i, ch in enumerate(chars)}
+    print(f"corpus: {len(text):,} chars, vocab {len(chars)}")
+
+    data = np.array([stoi[c] for c in text], dtype=np.uint16)
+    n = len(data)
+    train, val = data[: int(n * 0.9)], data[int(n * 0.9) :]
+    train.tofile(os.path.join(args.out_dir, "train.bin"))
+    val.tofile(os.path.join(args.out_dir, "val.bin"))
+    with open(os.path.join(args.out_dir, "meta.pkl"), "wb") as f:
+        pickle.dump({"vocab_size": len(chars), "stoi": stoi, "itos": itos}, f)
+    print(f"train {len(train):,} tokens / val {len(val):,} tokens")
+
+
+if __name__ == "__main__":
+    main()

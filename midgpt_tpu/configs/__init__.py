@@ -37,7 +37,9 @@ def openwebtext() -> ExperimentConfig:
         data_dir="data/openwebtext",
         learning_rate=1e-3, min_lr=1e-5, warmup_steps=5000,
         lr_decay_steps=60000, max_steps=60000,
-        batch_size=128, g_accum_iters=16,  # effective 2048
+        # our batch_size is GLOBAL incl. accumulation; the reference's 128 x 16
+        # accumulation steps (configs/openwebtext.py:18) = 2048 seqs/update
+        batch_size=2048, g_accum_iters=16,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
     )

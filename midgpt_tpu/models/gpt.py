@@ -106,20 +106,88 @@ class Attention:
             q = shard_act(q, "batch", "heads", "seq", "head_dim")
             k = shard_act(k, "batch", "kv_heads", "seq", "head_dim")
             v = shard_act(v, "batch", "kv_heads", "seq", "head_dim")
-            out = attention(
-                q,
-                k,
-                v,
-                impl=impl,
-                causal=True,
-                dropout_rate=self.dropout_rate,
-                dropout_key=adrop_key,
-                deterministic=deterministic,
-            )
+            if impl == "ring":
+                from midgpt_tpu.parallel.ring import ring_attention
+                from midgpt_tpu.parallel.sharding import current_mesh
+
+                mesh = current_mesh()
+                assert mesh is not None, (
+                    "attn_impl='ring' requires running inside axis_rules(mesh)"
+                )
+                assert self.dropout_rate == 0.0 or deterministic, (
+                    "ring attention does not support attention dropout"
+                )
+                out = ring_attention(q, k, v, mesh)
+            else:
+                out = attention(
+                    q,
+                    k,
+                    v,
+                    impl=impl,
+                    causal=True,
+                    dropout_rate=self.dropout_rate,
+                    dropout_key=adrop_key,
+                    deterministic=deterministic,
+                )
             out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
             out = self.wo(out)
             out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
             return shard_act(out, "batch", "seq", "embed")
+
+
+    def decode(
+        self,
+        x: Array,  # [B, 1, D] — one new token per sequence
+        cache_k: Array,  # [B, Hkv, T_max, C]
+        cache_v: Array,  # [B, Hkv, T_max, C]
+        pos: Array,  # [] int32 — number of tokens already in the cache
+        sin_t: Array,  # [T_max, C//2] rope tables
+        cos_t: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Single-token incremental attention against a KV cache.
+
+        The reference has no decode path (sample.py:72-94 re-runs the full
+        forward per token); this is the TPU-native replacement: O(T) per
+        token, static shapes, jit/scan-friendly."""
+        b, one, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        t_max = cache_k.shape[2]
+        qkv = self.wqkv(x)  # [B, 1, (H+2Hkv)C]
+        q = qkv[..., : h * c].reshape(b, 1, h, c)
+        k = qkv[..., h * c : (h + hkv) * c].reshape(b, 1, hkv, c)
+        v = qkv[..., (h + hkv) * c :].reshape(b, 1, hkv, c)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+            k = self.k_norm(k)
+        q = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, 1, C]
+        k = jnp.transpose(k, (0, 2, 1, 3))  # [B, Hkv, 1, C]
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        # rope at position `pos`
+        sin_row = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, axis=0)
+        cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)
+        q = apply_rotary(q, sin_row, cos_row)
+        k = apply_rotary(k, sin_row, cos_row)
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, k.astype(cache_k.dtype), pos, axis=2
+        )
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, v.astype(cache_v.dtype), pos, axis=2
+        )
+        # attend to cache positions <= pos (static shape, masked)
+        qg = q.reshape(b, hkv, h // hkv, 1, c)
+        scores = jnp.einsum(
+            "bkgqc,bkjc->bkgqj", qg, cache_k, preferred_element_type=jnp.float32
+        )  # [B, Hkv, G, 1, T_max]
+        idx = jnp.arange(t_max)
+        mask = jnp.where(idx <= pos, 0.0, -jnp.inf).astype(jnp.float32)
+        probs = jax.nn.softmax(
+            (scores + mask) / math.sqrt(c), axis=-1
+        ).astype(cache_v.dtype)
+        out = jnp.einsum("bkgqj,bkjc->bkgqc", probs, cache_v)
+        out = out.reshape(b, h, 1, c)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
+        return self.wo(out), cache_k, cache_v
 
 
 @module
@@ -206,6 +274,14 @@ class Block:
         )
         x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
         return x
+
+    def decode(self, x, cache_k, cache_v, pos, sin_t, cos_t):
+        attn_out, cache_k, cache_v = self.attn.decode(
+            self.ln1(x), cache_k, cache_v, pos, sin_t, cos_t
+        )
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, cache_k, cache_v
 
 
 @module
@@ -295,6 +371,76 @@ class GPT:
             )
             logits = h @ head_w  # [B, T, V]
             return shard_act(logits, "batch", "seq", "vocab")
+
+
+@module
+class KVCache:
+    """Per-layer KV cache; leaves carry a leading n_layer axis, matching the
+    scan-stacked block params."""
+
+    k: Array  # [L, B, Hkv, T_max, C]
+    v: Array  # [L, B, Hkv, T_max, C]
+
+    @staticmethod
+    def init(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim)
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def decode_step(
+    model: GPT,
+    tokens: Array,  # [B] int32 — the newest token per sequence
+    pos: Array,  # [] int32 — how many tokens are already cached
+    cache: KVCache,
+) -> tp.Tuple[Array, KVCache]:
+    """One incremental decoding step: logits for the next token + updated
+    cache. O(T_max) per token vs the reference's O(T * full-forward)
+    (sample.py:72-94)."""
+    cfg = model.config
+    t_max = cache.k.shape[3]
+    sin_np, cos_np = rope_tables(cfg.head_dim, t_max, cfg.rope_base)
+    sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    h = model.wte(tokens[:, None])  # [B, 1, D]
+
+    def body(carry, layer):
+        x = carry
+        block, ck, cv = layer
+        x, ck, cv = block.decode(
+            x, ck, cv, pos, sin_t.astype(x.dtype), cos_t.astype(x.dtype)
+        )
+        return x, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        body, h, (model.blocks, cache.k, cache.v), unroll=cfg.scan_unroll
+    )
+    h = model.ln_f(h)
+    head_w = (
+        model.wte.weight.T.astype(h.dtype)
+        if model.lm_head is None
+        else model.lm_head.weight.astype(h.dtype)
+    )
+    logits = (h @ head_w)[:, 0, :]  # [B, V]
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def prefill(
+    model: GPT, tokens: Array, cache: KVCache
+) -> tp.Tuple[Array, KVCache]:
+    """Fill the cache with a prompt by stepping decode_step over its tokens
+    (simple and correct; a blockwise prefill kernel is a later perf item).
+    Returns logits after the last prompt token + the filled cache."""
+
+    def body(carry, tok):
+        pos, cache = carry
+        logits, cache = decode_step(model, tok, pos, cache)
+        return (pos + 1, cache), logits
+
+    b, t = tokens.shape
+    (_, cache), logits_all = jax.lax.scan(
+        body, (jnp.zeros((), jnp.int32), cache), jnp.transpose(tokens)
+    )
+    return logits_all[-1], cache
 
 
 def count_params(model: GPT) -> int:

@@ -86,7 +86,10 @@ def attention(
       flash - Pallas blockwise online-softmax kernel
     """
     if impl == "auto":
-        on_tpu = any(d.platform != "cpu" for d in jax.devices())
+        # platform is "tpu" natively, "axon" through the tunnel (kind "TPU v5...")
+        on_tpu = any(
+            "tpu" in f"{d.platform} {d.device_kind}".lower() for d in jax.devices()
+        )
         use_flash = (
             on_tpu
             and (dropout_rate == 0.0 or deterministic)

@@ -33,11 +33,15 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30  # avoids NaN from (-inf) - (-inf) in fully-masked rows
 
 
-def _block_sizes(t: int, bq: int, bk: int) -> tp.Tuple[int, int]:
+def _block_sizes(t: int, bq: int, bk: int, causal: bool) -> tp.Tuple[int, int]:
     bq = min(bq, t)
     bk = min(bk, t)
     assert t % bq == 0 and t % bk == 0, (
         f"seq len {t} must be a multiple of block sizes ({bq}, {bk})"
+    )
+    # the causal block-skip logic compares q/k block indices directly
+    assert not causal or bq == bk, (
+        f"causal path requires block_q == block_k, got ({bq}, {bk})"
     )
     return bq, bk
 
@@ -117,7 +121,7 @@ def _flash_forward(
     hkv, s = k.shape[1], k.shape[2]
     assert s == t, "self-attention only (use decode path for caches)"
     groups = h // hkv
-    bq, bk = _block_sizes(t, bq, bk)
+    bq, bk = _block_sizes(t, bq, bk, causal)
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
 
@@ -269,7 +273,7 @@ def _flash_backward(
     b, h, t, c = q.shape
     hkv = k.shape[1]
     groups = h // hkv
-    bq, bk = _block_sizes(t, bq, bk)
+    bq, bk = _block_sizes(t, bq, bk, causal)
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
 

@@ -1,0 +1,155 @@
+"""Ring attention: causal self-attention sharded over the mesh 'sequence'
+axis (context parallelism).
+
+Absent from the reference (SURVEY.md 5.7: full T on every device, O(T^2)
+memory); this is the long-context mechanism the rebuild owes. Design:
+
+- Q/K/V arrive as GLOBAL arrays with T sharded over the 'sequence' axis;
+  RoPE was already applied upstream with global positions (GSPMD keeps that
+  correct automatically).
+- Inside ``jax.shard_map`` each device holds one T/s chunk. K/V chunks
+  rotate around the ring with ``lax.ppermute`` (pure ICI neighbor traffic,
+  no all-gather); each hop computes a chunk-pair attention and the partial
+  results merge via streaming log-sum-exp — numerically identical to full
+  softmax attention.
+- Causality by chunk index: source chunk j contributes to query chunk i
+  fully if j < i, causally-masked if j == i, not at all if j > i (the hop
+  is skipped with a -inf lse so the merge ignores it).
+
+The per-chunk-pair math here is the naive oracle (differentiable end to
+end through ppermute's transpose — bwd runs the ring in reverse
+automatically). Fusing the Pallas flash kernel into the ring (needs a
+custom ring VJP because the merge consumes lse) is tracked as a perf item.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+_NEG_INF = -1e30
+
+
+def _chunk_attention(
+    q: Array,  # [B, H, Tq, C]
+    k: Array,  # [B, Hkv, Tk, C]
+    v: Array,  # [B, Hkv, Tk, C]
+    mode: Array,  # [] int32: 0 = skip, 1 = causal (diagonal), 2 = full
+) -> tp.Tuple[Array, Array]:
+    """Attention of one (q-chunk, kv-chunk) pair -> (out[B,H,Tq,C] f32
+    UNNORMALIZED, lse[B,H,Tq] f32). Reference-parity math: scores from
+    compute-dtype inputs, f32 softmax with 1/sqrt(C) folded in
+    (model.py:71-79)."""
+    b, h, tq, c = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    groups = h // hkv
+    qg = q.reshape(b, hkv, groups, tq, c)
+    scores = jnp.einsum(
+        "bkgqc,bkjc->bkgqj", qg, k, preferred_element_type=jnp.float32
+    )
+    scale = 1.0 / math.sqrt(c)
+    z = scores * scale  # [B, Hkv, G, Tq, Tk]
+
+    causal = (
+        jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+    )  # same-chunk relative causality
+    # mode: 0 -> all masked; 1 -> causal mask; 2 -> none masked
+    visible = jnp.where(
+        mode == 0,
+        jnp.zeros((tq, tk), bool),
+        jnp.where(mode == 1, causal, jnp.ones((tq, tk), bool)),
+    )
+    z = jnp.where(visible, z, _NEG_INF)
+    m = jnp.max(z, axis=-1)  # [B, Hkv, G, Tq]
+    p = jnp.exp(z - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bkgqj,bkjc->bkgqc", p.astype(v.dtype), v).astype(jnp.float32)
+    # NORMALIZED chunk softmax output + its logsumexp
+    out = out / jnp.maximum(l, 1e-30)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    # fully-masked rows: lse = -inf so the merge ignores this hop
+    lse = jnp.where(m <= _NEG_INF / 2, -jnp.inf, lse)
+    return out.reshape(b, h, tq, c), lse.reshape(b, h, tq)
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Merge two normalized chunk-softmax partials: softmax-weighted average
+    over their logsumexps."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+    w1 = jnp.where(jnp.isinf(lse1), 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(jnp.isinf(lse2), 0.0, jnp.exp(lse2 - m_safe))
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    out = (o1 * w1[..., None] + o2 * w2[..., None]) / denom[..., None]
+    lse = m_safe + jnp.log(denom)
+    lse = jnp.where(jnp.isinf(lse1) & jnp.isinf(lse2), -jnp.inf, lse)
+    return out, lse
+
+
+def _ring_body(q, k, v, axis_name: str):
+    """Per-device program: local chunks in, attention output chunk out."""
+    s = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % s) for i in range(s)]  # send kv to the next device
+
+    # hop 0: own chunk (diagonal -> causal)
+    out, lse = _chunk_attention(q, k, v, jnp.asarray(1, jnp.int32))
+
+    def hop(r, carry):
+        out, lse, k, v = carry
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        src = (idx - r) % s  # chunk index now held
+        mode = jnp.where(src < idx, 2, 0).astype(jnp.int32)  # full or skip
+        o_r, lse_r = _chunk_attention(q, k, v, mode)
+        out, lse = _merge(out, lse, o_r, lse_r)
+        return out, lse, k, v
+
+    out, lse, _, _ = jax.lax.fori_loop(1, s, hop, (out, lse, k, v))
+    return out.astype(q.dtype)  # partials merge pre-normalized
+
+
+def ring_attention(
+    q: Array,  # [B, H, T, C] global, T sharded over 'sequence'
+    k: Array,  # [B, Hkv, T, C]
+    v: Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sequence",
+    batch_axes: tp.Tuple[str, ...] = ("replica", "fsdp"),
+    head_axis: tp.Optional[str] = "tensor",
+) -> Array:
+    """Causal ring attention over the mesh. Differentiable (autodiff
+    transposes the ppermute ring). T must divide by the axis size."""
+    s = mesh.shape[axis_name]
+    t = q.shape[2]
+    assert t % s == 0, f"T={t} not divisible by sequence axis {s}"
+
+    # only shard batch/head dims over axes that actually divide them
+    def fit(dim: int, axes: tp.Sequence[str]) -> tp.Tuple[str, ...]:
+        kept: tp.List[str] = []
+        prod = 1
+        for a in axes:
+            if dim % (prod * mesh.shape[a]) == 0:
+                kept.append(a)
+                prod *= mesh.shape[a]
+        return tuple(kept)
+
+    b_axes = fit(q.shape[0], batch_axes)
+    h_axes = fit(k.shape[1], (head_axis,) if head_axis else ())
+    spec = P(b_axes if b_axes else None, h_axes if h_axes else None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_body, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -78,6 +78,11 @@ class axis_rules:
         return False
 
 
+def current_mesh() -> tp.Optional[Mesh]:
+    """Mesh of the innermost active ``axis_rules`` scope, if any."""
+    return _CTX.mesh
+
+
 def logical_to_spec(logical_axes: tp.Sequence[tp.Optional[str]],
                     rules: tp.Optional[LogicalRules] = None) -> P:
     if rules is None:

@@ -1,0 +1,104 @@
+"""Observability: throughput/MFU computed in-train + structured logging.
+
+The reference computes throughput from the tqdm rate and MFU offline
+(SURVEY.md 5.1, 5.5); here both are first-class: model FLOPs from the
+config, per-device peak FLOPs from the device kind, metrics appended to a
+JSONL file in the rundir (wandb optional, gated on import)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import typing as tp
+
+import jax
+
+from midgpt_tpu.config import ExperimentConfig, ModelConfig, to_dict
+
+# bf16 peak FLOPs/s per chip by device kind substring
+_PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5": 197e12,  # v5e / v5 lite
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device: tp.Optional[jax.Device] = None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e-class if unknown
+
+
+def flops_per_token(model: ModelConfig, seq_len: tp.Optional[int] = None) -> float:
+    """Training FLOPs/token (fwd+bwd), PaLM-style 6N + attention term."""
+    t = seq_len or model.block_size
+    d = model.n_embd
+    c = model.head_dim
+    f = int(model.mlp_ratio * d)
+    # parameter FLOPs (2 per MAC, x3 for fwd+bwd)
+    qkv = d * (model.n_head + 2 * model.kv_heads) * c
+    proj = model.n_head * c * d
+    mlp = (3 if model.mlp == "swiglu" else 2) * d * f
+    per_layer = qkv + proj + mlp
+    n_matmul = model.n_layer * per_layer + 2 * d * model.vocab_size
+    param_flops = 6 * n_matmul
+    # attention score/value FLOPs: 2 matmuls of T x C per head, causal ~1/2
+    attn_flops = 6 * 2 * model.n_layer * model.n_head * c * t  # per token
+    attn_flops = attn_flops / 2  # causal
+    return param_flops + attn_flops
+
+
+def mfu(tokens_per_sec: float, model: ModelConfig, n_devices: int) -> float:
+    achieved = tokens_per_sec * flops_per_token(model)
+    peak = device_peak_flops() * n_devices
+    return achieved / peak
+
+
+class MetricLogger:
+    """JSONL metrics + optional wandb, process-0 only (parity:
+    launch.py:38-68 / train.py:212-213 wandb logging)."""
+
+    def __init__(self, rundir: str, config: ExperimentConfig, use_wandb: bool = False):
+        self.is_main = jax.process_index() == 0
+        self._file = None
+        self._wandb = None
+        if not self.is_main:
+            return
+        if rundir and not rundir.startswith("gs://"):
+            os.makedirs(rundir, exist_ok=True)
+            self._file = open(os.path.join(rundir, "metrics.jsonl"), "a")
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(
+                    dir=rundir or None,
+                    config=to_dict(config),
+                    resume="allow",
+                )
+            except Exception:
+                self._wandb = None
+
+    def log(self, step: int, metrics: tp.Mapping[str, float]) -> None:
+        if not self.is_main:
+            return
+        rec = {"step": step, "time": time.time(), **metrics}
+        if self._file is not None:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        if self._wandb is not None:
+            self._wandb.finish()

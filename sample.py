@@ -1,0 +1,115 @@
+"""Sampling CLI (parity: /root/reference/sample.py).
+
+    python sample.py --ckpt_dir=outputs/run [--start="text" | --start=FILE:f]
+                     [--num_samples=3] [--max_new_tokens=200]
+                     [--temperature=0.8] [--top_k=...] [--seed=0]
+
+Loads config.json + the latest checkpoint from the rundir, tokenizes with
+the dataset's meta.pkl char map if present else tiktoken GPT-2
+(sample.py:143-159), and generates with the KV-cached sampler."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+
+
+def get_tokenizer(data_dir: str):
+    meta_path = os.path.join(data_dir, "meta.pkl") if data_dir else ""
+    if meta_path and os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        stoi, itos = meta["stoi"], meta["itos"]
+        return (
+            lambda s: [stoi[c] for c in s],
+            lambda ids: "".join(itos[int(i)] for i in ids),
+        )
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        return enc.encode, lambda ids: enc.decode([int(i) for i in ids])
+    except Exception:
+        # zero-egress fallback: raw token ids
+        return (
+            lambda s: [int(tok) for tok in s.split()],
+            lambda ids: " ".join(str(int(i)) for i in ids),
+        )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--start", default="\n", help='prompt text or "FILE:path"')
+    ap.add_argument("--num_samples", type=int, default=3)
+    ap.add_argument("--max_new_tokens", type=int, default=200)
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--top_k", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.checkpoint import Checkpointer
+    from midgpt_tpu.config import from_dict
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.sampling import generate
+    from midgpt_tpu.train import TrainState, init_state, make_optimizer
+    from midgpt_tpu.parallel.mesh import single_device_mesh
+
+    with open(os.path.join(args.ckpt_dir, "config.json")) as f:
+        cfg = from_dict(json.load(f))
+
+    # abstract train-state skeleton with the optimizer subtree marked as
+    # PLACEHOLDER: only params are materialized (no Adam-moment memory)
+    import orbax.checkpoint as ocp
+
+    mesh = single_device_mesh()
+    tx, _ = make_optimizer(cfg)
+
+    def init_fn(key):
+        from midgpt_tpu.models.gpt import GPT
+
+        model = GPT.init(key, cfg.model)
+        opt_state = tx.init(model)
+        return TrainState(params=model, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    abstract = TrainState(
+        params=abstract.params,
+        opt_state=jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.opt_state),
+        step=abstract.step,
+    )
+    ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
+    state, meta = ckpt.restore(abstract)
+    print(f"restored step {meta['step']} from {args.ckpt_dir}")
+    model = state.params
+
+    encode, decode = get_tokenizer(cfg.data_dir)
+    start = args.start
+    if start.startswith("FILE:"):
+        with open(start[5:]) as f:
+            start = f.read()
+    prompt = np.asarray(encode(start), dtype=np.int32)
+    prompt = np.tile(prompt[None, :], (args.num_samples, 1))
+
+    model = cast_floating(model, jnp.bfloat16)
+    toks = generate(
+        model,
+        jnp.asarray(prompt),
+        args.max_new_tokens,
+        key=jax.random.PRNGKey(args.seed),
+        temperature=args.temperature,
+        top_k=args.top_k,
+    )
+    for i in range(args.num_samples):
+        print("-" * 40)
+        print(start + decode(np.asarray(toks[i])))
+
+
+if __name__ == "__main__":
+    main()

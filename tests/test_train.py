@@ -1,0 +1,119 @@
+"""Training-engine tests: optimizer parity, loss decreases end-to-end on
+the 8-device mesh, checkpoint round-trip + exact resume continuity."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig, ModelConfig
+from midgpt_tpu.data import write_tokens
+from midgpt_tpu.train import train, make_optimizer, make_lr_schedule
+
+
+def _tiny_cfg(tmp_path, **kw) -> ExperimentConfig:
+    data_dir = str(tmp_path / "data")
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    # highly-learnable stream: repeated ramps with noise
+    base = np.tile(np.arange(64), 4000)
+    noise = rng.integers(0, 64, size=base.shape)
+    toks = np.where(rng.random(base.shape) < 0.05, noise, base)
+    write_tokens(os.path.join(data_dir, "train.bin"), toks)
+    write_tokens(os.path.join(data_dir, "val.bin"), toks[:40_000])
+    defaults = dict(
+        model=ModelConfig(
+            block_size=32, vocab_size=64, n_layer=2, n_head=2, n_embd=64,
+            dropout=0.0, attn_impl="naive", remat="none",
+        ),
+        rundir=str(tmp_path / "run"),
+        data_dir=data_dir,
+        learning_rate=1e-2, min_lr=1e-3, warmup_steps=5,
+        lr_decay_steps=30, max_steps=30,
+        batch_size=8, g_accum_iters=2,
+        beta2=0.99, weight_decay=1e-4,
+        eval_interval=15, eval_batches=2, log_interval=5,
+        mesh=MeshConfig(replica=1, fsdp=2, sequence=2, tensor=2),
+    )
+    defaults.update(kw)
+    return ExperimentConfig(**defaults)
+
+
+def test_lr_schedule_shape():
+    config = ExperimentConfig(
+        model=ModelConfig(block_size=8, vocab_size=8, n_layer=1, n_head=1, n_embd=8),
+        learning_rate=1e-3, min_lr=1e-4, warmup_steps=10, lr_decay_steps=100,
+    )
+    sched = make_lr_schedule(config)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(100)), 1e-4, rtol=1e-5)
+
+
+def test_independent_weight_decay_scaling():
+    config = ExperimentConfig(
+        model=ModelConfig(block_size=8, vocab_size=8, n_layer=1, n_head=1, n_embd=8),
+        learning_rate=1e-3, weight_decay=1e-4,
+    )
+    tx, _ = make_optimizer(config)
+    # decay applied as wd/lr (parity: train.py:156); verify via a single
+    # update on a 1-param tree with zero grads past warmup
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    grads = {"w": jnp.zeros((4,))}
+    # run enough updates to get a nonzero schedule value
+    for _ in range(20):
+        updates, state = tx.update(grads, state, params)
+    # update = -schedule * (adam(0) + wd/lr * w); adam(0)=0
+    sched = make_lr_schedule(config)
+    expected = -float(sched(19)) * (config.weight_decay / config.learning_rate)
+    np.testing.assert_allclose(np.asarray(updates["w"]), expected, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_train_loss_decreases(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    final = train(cfg)
+    assert final["loss"] < 2.0, f"loss did not decrease: {final}"
+    assert final["val_loss"] < 2.5
+    # metrics file written
+    assert os.path.exists(os.path.join(cfg.rundir, "metrics.jsonl"))
+
+
+@pytest.mark.slow
+def test_final_checkpoint_saved_off_interval(tmp_path):
+    """Regression: max_steps not a multiple of the save interval must still
+    leave an end-of-run checkpoint (forced save at max_steps - 1)."""
+    from midgpt_tpu.checkpoint import Checkpointer
+
+    cfg = _tiny_cfg(
+        tmp_path, rundir=str(tmp_path / "run_off"), max_steps=17,
+        eval_interval=10, ckpt_interval=10,
+    )
+    train(cfg)
+    ckpt = Checkpointer(cfg.rundir, save_interval_steps=10)
+    assert ckpt.latest_step() == 16
+
+
+@pytest.mark.slow
+def test_resume_continuity(tmp_path):
+    """Train 30 steps straight vs 15 + resume 15: identical data order and
+    near-identical final loss (bf16 reductions aren't bitwise across
+    restarts)."""
+    cfg_full = _tiny_cfg(tmp_path, rundir=str(tmp_path / "run_full"))
+    final_full = train(cfg_full)
+
+    cfg_a = _tiny_cfg(
+        tmp_path, rundir=str(tmp_path / "run_resume"), max_steps=15,
+        ckpt_interval=15,
+    )
+    train(cfg_a)
+    cfg_b = dataclasses.replace(cfg_a, max_steps=30)
+    final_b = train(cfg_b)
+
+    assert abs(final_b["val_loss"] - final_full["val_loss"]) < 0.15, (
+        f"resume diverged: {final_b['val_loss']} vs {final_full['val_loss']}"
+    )
