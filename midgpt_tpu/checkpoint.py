@@ -5,12 +5,16 @@ Capability parity with the reference (/root/reference/src/train.py:139-145,
 save-every-eval-interval, sharding-aware restore, local disk or GCS),
 redesigned per SURVEY.md 5.4's critique:
 
-- saves STRUCTURED state (train state pytree + JSON metadata: step, loader
-  state, config fingerprint) instead of bare tree leaves, so checkpoints
-  don't silently couple to code structure;
-- restore takes an abstract template built from the live (sharded) state,
+- saves STRUCTURED state as named composite items (params / opt_state /
+  extra + JSON metadata: step, loader state, config fingerprint) instead
+  of bare tree leaves, so checkpoints don't silently couple to code
+  structure;
+- restore takes abstract templates built from the live (sharded) state,
   so every leaf lands on devices with its target NamedSharding directly
   (no host staging), including after mesh-shape changes;
+- partial restore is first-class: sampling restores only the ``params``
+  item — no Adam-moment memory (the reference rebuilds a dummy optimizer
+  just to match the checkpoint tree, sample.py:111-131);
 - data-loader state IS checkpointed (the reference's isn't — resume there
   changes data order).
 """
@@ -51,50 +55,55 @@ class Checkpointer:
     def save(
         self,
         step: int,
-        state: tp.Any,
+        items: tp.Mapping[str, tp.Any],
         meta: tp.Mapping[str, tp.Any],
         force: bool = False,
     ) -> bool:
-        """Async save; the manager no-ops between save intervals (parity:
-        train.py:214-215 calling save every iteration). ``force=True`` saves
-        regardless of the interval (end-of-run checkpoint)."""
+        """Async save of named pytree items + JSON metadata; the manager
+        no-ops between save intervals (parity: train.py:214-215 calling save
+        every iteration). ``force=True`` saves regardless of the interval
+        (end-of-run checkpoint)."""
+        assert "meta" not in items, "'meta' is reserved for the JSON metadata"
         return self._mngr.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
                 meta=ocp.args.JsonSave(dict(meta)),
+                **{k: ocp.args.StandardSave(v) for k, v in items.items()},
             ),
             force=force,
         )
 
     def restore(
-        self, state_template: tp.Any, step: tp.Optional[int] = None
-    ) -> tp.Tuple[tp.Any, tp.Dict[str, tp.Any]]:
-        """Restore into the shardings carried by ``state_template`` (a live
-        or abstract state pytree — parity: train.py:179-187)."""
+        self,
+        templates: tp.Mapping[str, tp.Any],
+        step: tp.Optional[int] = None,
+    ) -> tp.Tuple[tp.Dict[str, tp.Any], tp.Dict[str, tp.Any]]:
+        """Restore the named items in ``templates`` into the shardings their
+        template leaves carry (parity: train.py:179-187). Items present in
+        the checkpoint but not in ``templates`` are skipped — that's the
+        params-only sampling path."""
         step = step if step is not None else self._mngr.latest_step()
         assert step is not None, "no checkpoint to restore"
         default = jax.sharding.SingleDeviceSharding(jax.devices()[0])
 
         def _abstract(x):
-            if x is ocp.PLACEHOLDER:
-                return x  # subtree skipped on restore (e.g. opt state at sampling)
             sharding = getattr(x, "sharding", None)
             if not isinstance(sharding, jax.sharding.Sharding):
                 sharding = default  # abstract templates (eval_shape) carry none
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
-        abstract = jax.tree.map(
-            _abstract, state_template, is_leaf=lambda x: x is ocp.PLACEHOLDER
-        )
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
                 meta=ocp.args.JsonRestore(),
+                **{
+                    k: ocp.args.StandardRestore(jax.tree.map(_abstract, v))
+                    for k, v in templates.items()
+                },
             ),
         )
-        return restored["state"], dict(restored["meta"])
+        items = {k: restored[k] for k in templates}
+        return items, dict(restored["meta"])
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

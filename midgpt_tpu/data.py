@@ -8,19 +8,26 @@ Capability parity with the reference's loader (/root/reference/src/train.py:56-6
   2.3). Here every batch is a pure function of (seed, step, process_index)
   via a counter-based Philox generator — the loader "state" checkpointed is
   just the step number, and resume is exact.
-- Same throughput recipe: memmapped uint16 token file, vectorized
-  ``np.take`` window gather, targets = inputs shifted by one.
+- Same throughput recipe: memmapped uint16 token file, windows gathered by
+  the native multi-threaded C++ gather (midgpt_tpu.native, numpy fallback),
+  targets = inputs shifted by one.
 - Per-process contiguous shards (equal-size, unlike the reference's
   ``int(n/p)+1`` imbalance).
+- ``PrefetchLoader`` overlaps next-batch assembly (gather + host->device
+  transfer) with the device step on a background thread.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import queue
+import threading
 import typing as tp
 
 import numpy as np
+
+from midgpt_tpu.native import gather_windows
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,11 +84,11 @@ def sample_batch(
     offsets = rng.integers(
         0, len(shard.tokens) - block_size - 1, size=(n_seqs,)
     )
-    idx = offsets[:, None] + np.arange(block_size + 1)[None, :]
-    windows = np.take(shard.tokens, idx, axis=0).astype(np.int32)
-    x = windows[:, :-1].reshape(*batch_shape, block_size)
-    y = windows[:, 1:].reshape(*batch_shape, block_size)
-    return x, y
+    x, y = gather_windows(shard.tokens, offsets, block_size)
+    return (
+        x.reshape(*batch_shape, block_size),
+        y.reshape(*batch_shape, block_size),
+    )
 
 
 @dataclasses.dataclass
@@ -132,6 +139,124 @@ class Loader:
             f"loader seed changed: ckpt {state['seed']} vs config {self.seed}"
         )
         self.step = int(state["step"])
+
+
+class _PrefetchError:
+    """Wraps an exception raised on the prefetch thread for re-raising on
+    the consumer thread (a bare daemon-thread death would hang next())."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class PrefetchLoader:
+    """Background-thread prefetch around a Loader: the next batch is
+    gathered (and optionally pushed to device) while the current train step
+    runs. The reference assembles every batch synchronously between steps
+    (train.py:203-207); overlapping it removes that host time from the
+    step critical path.
+
+    ``transform`` (e.g. a make_global_array closure) runs on the prefetch
+    thread — jax.device_put / make_array_from_process_local_data are
+    thread-safe for this producer/consumer pattern.
+
+    Checkpointing goes through the wrapped loader's state_dict; the
+    prefetch queue is drained on load so resumed batches are exact.
+    """
+
+    def __init__(
+        self,
+        loader: Loader,
+        transform: tp.Optional[tp.Callable] = None,
+        depth: int = 2,
+    ):
+        self.loader = loader
+        self._transform = transform if transform is not None else lambda *b: b
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread: tp.Optional[threading.Thread] = None
+        # consumption is tracked here, not via loader.step: the worker may
+        # have drawn batches that no one has consumed yet
+        self._start_step = loader.step
+        self._consumed = 0
+
+    def _worker(
+        self, stop: threading.Event, q: "queue.Queue", begin_step: int
+    ) -> None:
+        # draws via the PURE loader.peek with a generation-local counter —
+        # the shared Loader is never mutated, so a join-timeout zombie
+        # cannot corrupt another generation's (or a resume's) data order
+        produced = 0
+        while not stop.is_set():
+            try:
+                batch = self._transform(
+                    *self.loader.peek(begin_step + produced)
+                )
+                produced += 1
+            except BaseException as exc:  # propagate to the consumer
+                batch = _PrefetchError(exc)
+            while not stop.is_set():
+                try:
+                    q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(batch, _PrefetchError):
+                return
+
+    def start(self) -> "PrefetchLoader":
+        if self._thread is None:
+            # each worker generation gets its own stop event + queue so a
+            # join-timeout zombie from a previous generation can never feed
+            # the current one
+            self._thread = threading.Thread(
+                target=self._worker,
+                args=(self._stop, self._queue, self._start_step + self._consumed),
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def next(self):
+        if self._thread is None:
+            self.start()
+        batch = self._queue.get()
+        if isinstance(batch, _PrefetchError):
+            self.stop()
+            raise batch.exc
+        self._consumed += 1
+        return batch
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            while True:  # unblock a producer stuck on a full queue
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            # A worker stuck >5s mid-transform stays alive, but it holds
+            # THIS generation's stop event (already set) + queue and only
+            # ever calls the pure loader.peek, so it can neither feed a
+            # later generation nor corrupt shared state.
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._queue.maxsize)
+
+    def state_dict(self) -> tp.Dict[str, int]:
+        # batches sitting in the queue (or in-flight on the worker) were
+        # drawn but never consumed — resume replays from the consumed count
+        return {
+            "step": self._start_step + self._consumed,
+            "seed": self.loader.seed,
+        }
+
+    def load_state_dict(self, state: tp.Mapping[str, int]) -> None:
+        self.stop()
+        self.loader.load_state_dict(state)
+        self._start_step = self.loader.step
+        self._consumed = 0
 
 
 def write_tokens(path: str, tokens: np.ndarray) -> None:

@@ -38,7 +38,7 @@ from midgpt_tpu.models.layers import (
     rope_tables,
 )
 from midgpt_tpu.ops.attention import attention
-from midgpt_tpu.parallel.sharding import shard_act
+from midgpt_tpu.parallel.sharding import current_mesh, shard_act
 from midgpt_tpu.pytree import module, static
 
 Array = jax.Array
@@ -337,7 +337,21 @@ class GPT:
             scan_keys = jax.random.split(block_key, cfg.n_layer)
 
         with jax.named_scope("gpt"):
-            h = self.wte(tokens)  # [B, T, D]
+            # When the vocab dim is tensor-sharded (GPT_PARAM_RULES), a
+            # jnp.take whose indexed dim is sharded forces SPMD into
+            # involuntary full rematerialization. The TPU-native embedding
+            # under TP is a one-hot contraction: GSPMD turns the sharded-V
+            # einsum into a partial matmul + psum over 'tensor', and the MXU
+            # eats it. With an unsharded vocab the plain gather is cheaper.
+            mesh = current_mesh()
+            if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+                one_hot = jax.nn.one_hot(
+                    tokens, cfg.vocab_size, dtype=self.wte.weight.dtype
+                )
+                one_hot = shard_act(one_hot, "batch", "seq", "vocab")
+                h = one_hot @ self.wte.weight  # [B, T, D]
+            else:
+                h = self.wte(tokens)  # [B, T, D]
             h = dropout(h, cfg.dropout, drop_key, deterministic)
             h = shard_act(h, "batch", "seq", "embed")
 

@@ -1,0 +1,113 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The compute path is JAX/XLA/Pallas; the host-side runtime around it —
+batch gather for the data feed — is C++ (midgpt_tpu/native/gather.cpp),
+built on first use with g++ (no pybind11 required). Every native entry
+point has a numpy fallback so the framework runs where no toolchain
+exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import typing as tp
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "gather.cpp")
+_LIB = os.path.join(_HERE, "libdatagather.so")
+
+_lock = threading.Lock()
+_lib: tp.Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-pthread", _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_library() -> tp.Optional[ctypes.CDLL]:
+    """The compiled gather library, building it on first call; None if no
+    toolchain is available (callers fall back to numpy)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.dg_gather.restype = ctypes.c_int
+        lib.dg_gather.argtypes = [
+            ctypes.c_void_p,  # tokens
+            ctypes.c_int64,  # n_tokens
+            ctypes.c_void_p,  # offsets
+            ctypes.c_int64,  # n_seqs
+            ctypes.c_int64,  # block_size
+            ctypes.c_void_p,  # x_out
+            ctypes.c_void_p,  # y_out
+            ctypes.c_int,  # n_threads
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def gather_windows(
+    tokens: np.ndarray,  # 1-D uint16
+    offsets: np.ndarray,  # 1-D int
+    block_size: int,
+    n_threads: tp.Optional[int] = None,
+) -> tp.Tuple[np.ndarray, np.ndarray]:
+    """(x, y) int32 [n_seqs, block_size] windows; y shifted by one.
+
+    Native multi-threaded gather when the library is available, else the
+    numpy path (same recipe as the reference's get_batch, train.py:61-62).
+    """
+    assert tokens.dtype == np.uint16 and tokens.ndim == 1
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n_seqs = len(offsets)
+    lib = load_library()
+    if lib is not None and tokens.flags["C_CONTIGUOUS"]:
+        x = np.empty((n_seqs, block_size), dtype=np.int32)
+        y = np.empty((n_seqs, block_size), dtype=np.int32)
+        if n_threads is None:
+            n_threads = min(os.cpu_count() or 1, 16)
+        rc = lib.dg_gather(
+            tokens.ctypes.data, len(tokens),
+            offsets.ctypes.data, n_seqs, block_size,
+            x.ctypes.data, y.ctypes.data, n_threads,
+        )
+        if rc == 0:
+            return x, y
+        raise IndexError("gather window out of range")
+    # numpy fallback
+    if np.any(offsets < 0) or np.any(offsets + block_size + 1 > len(tokens)):
+        raise IndexError("gather window out of range")
+    idx = offsets[:, None] + np.arange(block_size + 1)[None, :]
+    windows = np.take(tokens, idx, axis=0).astype(np.int32)
+    return windows[:, :-1], windows[:, 1:]

@@ -1,0 +1,67 @@
+// Native batch gather for the token-stream data pipeline.
+//
+// The reference's get_batch (/root/reference/src/train.py:56-66) gathers
+// block_size windows from a memmapped uint16 stream with numpy fancy
+// indexing — single-threaded, and it materializes an int64 index matrix of
+// the same size as the output. This library does the gather directly:
+// multi-threaded over sequences, uint16 -> int32 widening in-flight, no
+// index materialization, and x/y (shift-by-one) produced in one pass over
+// the source window.
+//
+// Exposed C ABI (ctypes-friendly, no pybind11 dependency):
+//   dg_gather(tokens, n_tokens, offsets, n_seqs, block_size, x_out, y_out,
+//             n_threads)
+//     tokens:   const uint16_t*  token stream (memmap or RAM)
+//     offsets:  const int64_t*   n_seqs window start positions
+//     x_out:    int32_t*         [n_seqs, block_size]
+//     y_out:    int32_t*         [n_seqs, block_size]  (= x shifted by one)
+//   returns 0 on success, -1 if any window would run past n_tokens.
+
+#include <cstdint>
+#include <atomic>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+int dg_gather(const uint16_t* tokens, int64_t n_tokens,
+              const int64_t* offsets, int64_t n_seqs, int64_t block_size,
+              int32_t* x_out, int32_t* y_out, int n_threads) {
+  // validate every window before touching output (full batch or nothing)
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    if (offsets[s] < 0 || offsets[s] + block_size + 1 > n_tokens) return -1;
+  }
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_seqs) n_threads = static_cast<int>(n_seqs);
+
+  std::atomic<int64_t> next_seq{0};
+  auto worker = [&]() {
+    for (;;) {
+      const int64_t s = next_seq.fetch_add(1, std::memory_order_relaxed);
+      if (s >= n_seqs) return;
+      const uint16_t* src = tokens + offsets[s];
+      int32_t* x = x_out + s * block_size;
+      int32_t* y = y_out + s * block_size;
+      // one pass over block_size+1 source tokens fills both x and y
+      int32_t prev = static_cast<int32_t>(src[0]);
+      for (int64_t t = 0; t < block_size; ++t) {
+        const int32_t cur = static_cast<int32_t>(src[t + 1]);
+        x[t] = prev;
+        y[t] = cur;
+        prev = cur;
+      }
+    }
+  };
+
+  if (n_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    for (int i = 0; i < n_threads; ++i) threads.emplace_back(worker);
+    for (auto& t : threads) t.join();
+  }
+  return 0;
+}
+
+}  // extern "C"

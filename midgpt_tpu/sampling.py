@@ -23,6 +23,8 @@ def _sample_token(logits: Array, key: Array, temperature: float, top_k: tp.Optio
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
+        assert top_k > 0, f"top_k must be positive, got {top_k}"
+        top_k = min(top_k, logits.shape[-1])  # clamp to vocab
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

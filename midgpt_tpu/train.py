@@ -30,7 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from midgpt_tpu.checkpoint import Checkpointer, config_fingerprint
 from midgpt_tpu.config import ExperimentConfig, to_dict
-from midgpt_tpu.data import Loader, load_shard
+from midgpt_tpu.data import Loader, PrefetchLoader, load_shard
 from midgpt_tpu.models.gpt import GPT, GPT_PARAM_RULES, count_params
 from midgpt_tpu.parallel.mesh import create_mesh
 from midgpt_tpu.parallel.sharding import (
@@ -202,6 +202,16 @@ def evaluate(
     return float(np.mean([float(l) for l in losses]))
 
 
+def _ckpt_items(state: TrainState) -> tp.Dict[str, tp.Any]:
+    """The named checkpoint items for a TrainState (single source of truth
+    for save AND restore templates)."""
+    return {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "extra": {"step": state.step},
+    }
+
+
 def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     """The orchestrator (parity: train.py:127-225). Returns final metrics."""
     assert cfg.rundir, "rundir required"
@@ -229,6 +239,17 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         process_index=proc,
         stream=1,
     )
+    # train-split eval gets its own single-microbatch loader (evaluate uses
+    # one microbatch; peeking the full (g_accum, B) train shape would gather
+    # g_accum x the data only to discard all but the first slice)
+    train_eval_loader = Loader(
+        shard=train_loader.shard,
+        block_size=t,
+        batch_shape=(1, local_b),
+        seed=cfg.data_seed,
+        process_index=proc,
+        stream=2,
+    )
 
     tx, schedule = make_optimizer(cfg)
     train_step = make_train_step(cfg, tx, mesh)
@@ -253,7 +274,12 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
 
     first_step = 0
     if ckpt.latest_step() is not None:
-        state, meta = ckpt.restore(state)
+        items, meta = ckpt.restore(_ckpt_items(state))
+        state = TrainState(
+            params=items["params"],
+            opt_state=items["opt_state"],
+            step=items["extra"]["step"],
+        )
         assert meta.get("model_fingerprint") == fingerprint, (
             "checkpoint was trained with a different model config"
         )
@@ -263,6 +289,16 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             print(f"resumed from step {meta['step']}")
 
     batch_spec = P(None, ("replica", "fsdp"), "sequence")
+    # next batch is gathered + device_put on a background thread while the
+    # current step runs (the reference pays this on the critical path,
+    # train.py:203-207)
+    prefetch = PrefetchLoader(
+        train_loader,
+        transform=lambda x, y: (
+            make_global_array(x, mesh, batch_spec),
+            make_global_array(y, mesh, batch_spec),
+        ),
+    ).start()
     tokens_per_step = cfg.batch_size * t
     last_log_time, last_log_step = time.time(), first_step
     final: tp.Dict[str, float] = {}
@@ -283,14 +319,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     for itr in pbar:
         if itr % cfg.eval_interval == 0 and itr > first_step:
             n_eval = 1 if cfg.debug else cfg.eval_batches
-            train_loss = evaluate(eval_step, state.params, train_loader, mesh, n_eval, itr)
+            train_loss = evaluate(
+                eval_step, state.params, train_eval_loader, mesh, n_eval, itr
+            )
             val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, itr)
             logger.log(itr, {"loss/train": train_loss, "loss/val": val_loss})
             final.update({"train_loss": train_loss, "val_loss": val_loss})
 
-        x, y = train_loader.next()
-        xg = make_global_array(x, mesh, batch_spec)
-        yg = make_global_array(y, mesh, batch_spec)
+        xg, yg = prefetch.next()
         step_key = jax.random.fold_in(key, itr)
 
         if cfg.debug and itr == first_step + 1 and not cfg.rundir.startswith("gs://"):
@@ -326,15 +362,16 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         if not cfg.debug:
             ckpt.save(
                 itr,
-                state,
+                _ckpt_items(state),
                 meta={
                     "step": itr,
-                    "loader": train_loader.state_dict(),
+                    "loader": prefetch.state_dict(),
                     "model_fingerprint": fingerprint,
                     "config": to_dict(cfg),
                 },
             )
 
+    prefetch.stop()
     # final eval + forced save of the last completed step (max_steps - 1;
     # the in-loop convention is "meta step == completed itr")
     n_eval = 1 if cfg.debug else cfg.eval_batches
@@ -349,10 +386,10 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     ):
         ckpt.save(
             cfg.max_steps - 1,
-            state,
+            _ckpt_items(state),
             meta={
                 "step": cfg.max_steps - 1,
-                "loader": train_loader.state_dict(),
+                "loader": prefetch.state_dict(),
                 "model_fingerprint": fingerprint,
                 "config": to_dict(cfg),
             },

@@ -47,7 +47,9 @@ def flops_per_token(model: ModelConfig, seq_len: tp.Optional[int] = None) -> flo
     proj = model.n_head * c * d
     mlp = (3 if model.mlp == "swiglu" else 2) * d * f
     per_layer = qkv + proj + mlp
-    n_matmul = model.n_layer * per_layer + 2 * d * model.vocab_size
+    # + the lm-head projection only: the token embedding is a gather (or a
+    # one-hot contraction of the same cost class under TP), not counted
+    n_matmul = model.n_layer * per_layer + d * model.vocab_size
     param_flops = 6 * n_matmul
     # attention score/value FLOPs: 2 matmuls of T x C per head, causal ~1/2
     attn_flops = 6 * 2 * model.n_layer * model.n_head * c * t  # per token

@@ -30,7 +30,10 @@ def get_tokenizer(data_dir: str):
         import tiktoken
 
         enc = tiktoken.get_encoding("gpt2")
-        return enc.encode, lambda ids: enc.decode([int(i) for i in ids])
+        return (
+            lambda s: enc.encode(s, allowed_special={"<|endoftext|>"}),
+            lambda ids: enc.decode([int(i) for i in ids]),
+        )
     except Exception:
         # zero-egress fallback: raw token ids
         return (
@@ -58,36 +61,23 @@ def main() -> None:
     from midgpt_tpu.config import from_dict
     from midgpt_tpu.pytree import cast_floating
     from midgpt_tpu.sampling import generate
-    from midgpt_tpu.train import TrainState, init_state, make_optimizer
-    from midgpt_tpu.parallel.mesh import single_device_mesh
 
     with open(os.path.join(args.ckpt_dir, "config.json")) as f:
         cfg = from_dict(json.load(f))
 
-    # abstract train-state skeleton with the optimizer subtree marked as
-    # PLACEHOLDER: only params are materialized (no Adam-moment memory)
-    import orbax.checkpoint as ocp
-
-    mesh = single_device_mesh()
-    tx, _ = make_optimizer(cfg)
-
+    # params-only restore: checkpoints store params / opt_state as separate
+    # items, so sampling never materializes Adam moments (the reference
+    # rebuilds a dummy optimizer just to match the tree, sample.py:111-131)
     def init_fn(key):
         from midgpt_tpu.models.gpt import GPT
 
-        model = GPT.init(key, cfg.model)
-        opt_state = tx.init(model)
-        return TrainState(params=model, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+        return GPT.init(key, cfg.model)
 
-    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-    abstract = TrainState(
-        params=abstract.params,
-        opt_state=jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.opt_state),
-        step=abstract.step,
-    )
+    abstract_params = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
-    state, meta = ckpt.restore(abstract)
+    items, meta = ckpt.restore({"params": abstract_params})
     print(f"restored step {meta['step']} from {args.ckpt_dir}")
-    model = state.params
+    model = items["params"]
 
     encode, decode = get_tokenizer(cfg.data_dir)
     start = args.start

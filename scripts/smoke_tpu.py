@@ -1,0 +1,57 @@
+"""Multihost TPU sanity smoke (parity: reference scripts/test_jax.py:34-58).
+
+Run on every host of a slice (e.g. via ``scripts/tpu.sh launch``-style ssh
+fan-out):
+
+    python scripts/smoke_tpu.py [--multihost]
+
+Builds the framework's 4-axis mesh over all devices, assembles a global
+array from per-host shards, runs a jitted sharded matmul, and prints the
+sharding layout from process 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--multihost", action="store_true")
+    args = ap.parse_args()
+    if args.multihost:
+        jax.distributed.initialize()
+
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+
+    proc = jax.process_index()
+    print(f"[proc {proc}] {jax.process_count()} processes, "
+          f"{jax.device_count()} devices ({jax.local_device_count()} local)")
+
+    mesh = create_mesh(MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1))
+    print(f"[proc {proc}] mesh: {dict(mesh.shape)}")
+
+    # per-host batch -> one global array (the train-loop data feed path)
+    rng = np.random.default_rng(proc)
+    local = rng.standard_normal((8, 1024)).astype(np.float32)
+    xg = make_global_array(local, mesh, P(("replica", "fsdp"), None))
+
+    w = jax.device_put(
+        rng.standard_normal((1024, 1024)).astype(np.float32),
+        NamedSharding(mesh, P(None, "tensor")),
+    )
+    y = jax.jit(lambda a, b: a @ b)(xg, w)
+    jax.block_until_ready(y)
+    print(f"[proc {proc}] matmul OK: {y.shape} {y.sharding}")
+    if proc == 0:
+        jax.debug.visualize_array_sharding(y)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# TPU fleet operations CLI (capability parity: reference
+# scripts/tpu_commands.sh:184-251 — list/describe/create/delete/setup/copy/
+# launch/check/stop/ssh/reboot/maintain — rebuilt for this framework).
+#
+# Usage:
+#   scripts/tpu.sh <verb> [args...]
+#
+# Configuration comes from env vars (no hardcoded project/zone like the
+# reference, tpu_commands.sh:188-200):
+#   TPU_PROJECT   gcloud project            (required for gcloud verbs)
+#   TPU_ZONE      e.g. us-east5-a
+#   TPU_NAME      TPU VM name
+#   TPU_TYPE      accelerator type, e.g. v5p-128 (create)
+#   TPU_VERSION   runtime version, e.g. v2-alpha-tpuv5 (create)
+#   TPU_REPO_DIR  remote checkout path (default: ~/midgpt_tpu)
+set -euo pipefail
+
+REPO_DIR_REMOTE="${TPU_REPO_DIR:-\$HOME/midgpt_tpu}"
+
+need() {
+  for v in "$@"; do
+    [[ -n "${!v:-}" ]] || { echo "error: \$$v must be set" >&2; exit 1; }
+  done
+}
+
+gc() { gcloud compute tpus tpu-vm "$@" --project "$TPU_PROJECT" --zone "$TPU_ZONE"; }
+
+# Run a command on every host of the slice, in parallel, through gcloud ssh.
+all_hosts() {
+  need TPU_PROJECT TPU_ZONE TPU_NAME
+  gc ssh "$TPU_NAME" --worker=all --command="$1"
+}
+
+cmd="${1:-help}"; shift || true
+case "$cmd" in
+  list)
+    need TPU_PROJECT TPU_ZONE
+    gcloud compute tpus tpu-vm list --project "$TPU_PROJECT" --zone "$TPU_ZONE"
+    ;;
+  describe)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    gc describe "$TPU_NAME"
+    ;;
+  ips)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    # gcloud joins repeated fields with ';' — emit one IP per line
+    gc describe "$TPU_NAME" \
+      --format='value(networkEndpoints[].accessConfig.externalIp)' \
+      | tr ';' '\n' | sed '/^$/d'
+    ;;
+  create)
+    need TPU_PROJECT TPU_ZONE TPU_NAME TPU_TYPE TPU_VERSION
+    gc create "$TPU_NAME" \
+      --accelerator-type "$TPU_TYPE" --version "$TPU_VERSION" "$@"
+    ;;
+  retry_create)
+    # loop on stockout/quota errors (parity: tpu_commands.sh:40-45);
+    # config errors fail fast, and the loop is bounded
+    need TPU_PROJECT TPU_ZONE TPU_NAME TPU_TYPE TPU_VERSION
+    attempts="${TPU_RETRY_LIMIT:-120}"
+    until "$0" create "$@"; do
+      attempts=$((attempts - 1))
+      [[ $attempts -gt 0 ]] || { echo "retry limit reached" >&2; exit 1; }
+      echo "create failed; retrying in 60s ($attempts attempts left)..." >&2
+      sleep 60
+    done
+    ;;
+  delete)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    gc delete "$TPU_NAME" --quiet
+    ;;
+  setup)
+    # install deps on every host (parity: setup.sh:8-10)
+    all_hosts "pip install -q -U 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html optax orbax-checkpoint tqdm wandb gcsfs tiktoken"
+    ;;
+  copy)
+    # rsync the local checkout to every host (parity: tpu_commands.sh copy)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    src="$(cd "$(dirname "$0")/.." && pwd)"
+    for ip in $("$0" ips); do
+      rsync -az --exclude outputs --exclude .git --exclude '*.so' \
+        "$src/" "$ip:${REPO_DIR_REMOTE#\$HOME/}/" &
+    done
+    wait
+    ;;
+  launch)
+    # start training in a detached tmux on every host
+    # usage: tpu.sh launch <config> <rundir> [extra launch.py args...]
+    config="${1:?usage: tpu.sh launch <config> <rundir> [args...]}"; shift
+    rundir="${1:?rundir required for multihost}"; shift
+    all_hosts "cd $REPO_DIR_REMOTE && tmux new-session -d -s train \
+      'python launch.py --config=$config --rundir=$rundir --multihost $* 2>&1 | tee train.log'"
+    ;;
+  check)
+    # tail the training log on every host (parity: tpu_commands.sh:79-91)
+    all_hosts "tail -n ${1:-20} $REPO_DIR_REMOTE/train.log"
+    ;;
+  stop)
+    all_hosts "tmux kill-session -t train || true"
+    ;;
+  ssh)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    gc ssh "$TPU_NAME" --worker="${1:-0}"
+    ;;
+  reboot)
+    all_hosts "sudo reboot" || true
+    ;;
+  maintain)
+    # rehearse preemption + checkpoint resume (parity: tpu_commands.sh:142-151)
+    need TPU_PROJECT TPU_ZONE TPU_NAME
+    gc simulate-maintenance-event "$TPU_NAME" --workers=all
+    ;;
+  df)
+    all_hosts "df -h | head -5"
+    ;;
+  help|*)
+    sed -n '2,16p' "$0"
+    echo "verbs: list describe ips create retry_create delete setup copy launch check stop ssh reboot maintain df"
+    ;;
+esac
