@@ -41,6 +41,7 @@ class ModelConfig:
     # False = reference semantics: shared init, independent params
     # (model.py:134-138, SURVEY.md 2.3)
     attn_impl: str = "auto"  # auto | naive | flash | ring
+    norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)
     remat: str = "full"  # full | dots | none  (model.py:149 uses full)
     scan_unroll: int = 1  # lax.scan unroll over layers (model.py:154-155)
 
@@ -125,6 +126,7 @@ class ExperimentConfig:
     seed: int = 0
     data_seed: int = 1234  # seeded loader (fixes train.py:60 nondeterminism)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    use_wandb: bool = False  # wandb.init on proc 0 (parity: launch.py:68)
     debug: bool = False
 
     @property

@@ -251,8 +251,8 @@ class Block:
             attn=Attention.init(k1, cfg),
             mlp=MLP.init(k2, cfg),
             # weightless block norms (model.py:94-95, layers.py:64-68)
-            ln1=RMSNorm.init(cfg.n_embd, use_weight=False),
-            ln2=RMSNorm.init(cfg.n_embd, use_weight=False),
+            ln1=RMSNorm.init(cfg.n_embd, use_weight=False, impl=cfg.norm_impl),
+            ln2=RMSNorm.init(cfg.n_embd, use_weight=False, impl=cfg.norm_impl),
         )
 
     def __call__(
@@ -312,7 +312,9 @@ class GPT:
         return GPT(
             wte=Embedding(weight=wte_wt),
             blocks=blocks,
-            ln_f=RMSNorm.init(cfg.n_embd, use_weight=False, eps=1e-5),
+            ln_f=RMSNorm.init(
+                cfg.n_embd, use_weight=False, eps=1e-5, impl=cfg.norm_impl
+            ),
             lm_head=lm_head,
             config=cfg,
         )

@@ -69,18 +69,46 @@ class Linear:
 
 @module
 class RMSNorm:
-    """x * rsqrt(mean(x^2, -1) + eps) [* weight] (parity: layers.py:60-75)."""
+    """x * rsqrt(mean(x^2, -1) + eps) [* weight] (parity: layers.py:60-75).
+
+    impl: "jnp" (XLA-fused elementwise chain) | "fused" (Pallas one-pass
+    kernel, midgpt_tpu.ops.fused_norm) | "auto" (jnp — flip to fused where
+    profiling shows a win). The fused path needs D % 128 == 0 and a TPU;
+    otherwise it silently falls back to jnp.
+    """
 
     weight: tp.Optional[Array]  # [D] or None
     eps: float = static(default=1e-6)
+    impl: str = static(default="auto")
 
     @staticmethod
-    def init(dim: int, use_weight: bool = False, eps: float = 1e-6) -> "RMSNorm":
+    def init(
+        dim: int, use_weight: bool = False, eps: float = 1e-6,
+        impl: str = "auto",
+    ) -> "RMSNorm":
         w = jnp.ones((dim,), dtype=jnp.float32) if use_weight else None
-        return RMSNorm(weight=w, eps=eps)
+        return RMSNorm(weight=w, eps=eps, impl=impl)
 
     def __call__(self, x: Array) -> Array:
         with jax.named_scope("rmsnorm"):
+            if (
+                self.impl == "fused"
+                and x.shape[-1] % 128 == 0
+                # same platform probe as the attention dispatch: "tpu"
+                # natively, device_kind "TPU v5..." through the axon tunnel
+                and any(
+                    "tpu" in f"{d.platform} {d.device_kind}".lower()
+                    for d in jax.devices()
+                )
+            ):
+                from midgpt_tpu.ops.fused_norm import fused_rms_norm
+
+                w = (
+                    self.weight.astype(x.dtype)
+                    if self.weight is not None
+                    else None
+                )
+                return fused_rms_norm(x, w, self.eps)
             out = x * jax.lax.rsqrt(
                 jnp.mean(jnp.square(x), axis=-1, keepdims=True) + self.eps
             )

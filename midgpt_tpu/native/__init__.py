@@ -27,16 +27,24 @@ _tried = False
 
 
 def _build() -> bool:
+    # build to a process-unique temp path and rename into place: publication
+    # is atomic, so concurrent builders can't hand a half-written .so to a
+    # loader, and a rebuild never truncates a file another process has
+    # already dlopen'd
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        "-pthread", _SRC, "-o", _LIB,
+        "-pthread", _SRC, "-o", tmp,
     ]
     try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=120
-        )
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -1,0 +1,152 @@
+"""Pallas TPU fused RMSNorm (forward + custom VJP).
+
+The reference computes RMSNorm in plain jnp (/root/reference/src/layers.py:
+60-75); XLA fuses the elementwise chain but still materializes the
+normalized activation between the reduce and the consumer. This kernel does
+the reduce + scale in one VMEM pass per row block and saves only the [N, 1]
+reciprocal-RMS for the backward, which recomputes nothing else.
+
+Math (identical to layers.RMSNorm, f32 accumulation):
+    r  = rsqrt(mean(x^2, -1) + eps)
+    y  = x * r * w            (w optional)
+    g  = dy * w
+    dx = r * g - x * r^3 / D * sum(g * x, -1)
+    dw = sum_rows(dy * x * r)   (computed in jnp; one fused reduce)
+
+Layout: any [..., D] input, flattened to [N, D]; D must be a multiple of
+128 (lane width) — callers fall back to the jnp path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps: float, has_weight: bool):
+    x = x_ref[:].astype(jnp.float32)  # [bn, D]
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=1, keepdims=True) + eps)
+    y = x * r
+    if has_weight:
+        y = y * w_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    rstd_ref[:] = r
+
+
+def _bwd_kernel(x_ref, w_ref, dy_ref, rstd_ref, dx_ref, *, has_weight: bool):
+    x = x_ref[:].astype(jnp.float32)  # [bn, D]
+    dy = dy_ref[:].astype(jnp.float32)
+    r = rstd_ref[:]  # [bn, 1] f32
+    g = dy * w_ref[:].astype(jnp.float32) if has_weight else dy
+    d = x.shape[1]
+    proj = jnp.sum(g * x, axis=1, keepdims=True) / d  # [bn, 1]
+    dx = r * g - x * (r * r * r) * proj
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _flatten(x: Array) -> tp.Tuple[Array, tp.Tuple[int, ...]]:
+    return x.reshape(-1, x.shape[-1]), x.shape
+
+
+def _pad_rows(n: int, bn: int) -> int:
+    return (bn - n % bn) % bn
+
+
+def _run_fwd(x2: Array, w: tp.Optional[Array], eps: float, bn: int):
+    n, d = x2.shape
+    has_weight = w is not None
+    w2 = (w if has_weight else jnp.ones((d,), x2.dtype)).reshape(1, d)
+    pad = _pad_rows(n, bn)
+    if pad:
+        x2 = jnp.concatenate([x2, jnp.ones((pad, d), x2.dtype)], axis=0)
+    grid = (x2.shape[0] // bn,)
+    y, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, has_weight=has_weight),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
+        ],
+    )(x2, w2)
+    if pad:
+        y, rstd = y[:n], rstd[:n]
+    return y, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rms_norm(
+    x: Array,
+    weight: tp.Optional[Array],
+    eps: float = 1e-6,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+) -> Array:
+    """RMSNorm over the last dim; ``weight`` is [D] or None."""
+    x2, shape = _flatten(x)
+    y, _ = _run_fwd(x2, weight, eps, block_rows)
+    return y.reshape(shape)
+
+
+def _vjp_fwd(x, weight, eps, block_rows):
+    x2, shape = _flatten(x)
+    y, rstd = _run_fwd(x2, weight, eps, block_rows)
+    return y.reshape(shape), (x2, weight, rstd, shape)
+
+
+def _vjp_bwd(eps, block_rows, residuals, dy):
+    x2, weight, rstd, shape = residuals
+    n, d = x2.shape
+    bn = block_rows
+    has_weight = weight is not None
+    dy2 = dy.reshape(n, d)
+    w2 = (weight if has_weight else jnp.ones((d,), x2.dtype)).reshape(1, d)
+    pad = _pad_rows(n, bn)
+    x_p, dy_p, rstd_p = x2, dy2, rstd
+    if pad:
+        x_p = jnp.concatenate([x2, jnp.ones((pad, d), x2.dtype)], axis=0)
+        dy_p = jnp.concatenate([dy2, jnp.zeros((pad, d), dy2.dtype)], axis=0)
+        rstd_p = jnp.concatenate(
+            [rstd, jnp.ones((pad, 1), rstd.dtype)], axis=0
+        )
+    grid = (x_p.shape[0] // bn,)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, has_weight=has_weight),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x_p.shape, x2.dtype),
+    )(x_p, w2, dy_p, rstd_p)
+    if pad:
+        dx = dx[:n]
+    if has_weight:
+        # one fused reduce; not worth a cross-block accumulation kernel
+        dw = jnp.sum(
+            dy2.astype(jnp.float32) * x2.astype(jnp.float32) * rstd, axis=0
+        ).astype(weight.dtype)
+    else:
+        dw = None
+    return dx.reshape(shape), dw
+
+
+fused_rms_norm.defvjp(_vjp_fwd, _vjp_bwd)

@@ -263,8 +263,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         ),
         async_save=not cfg.debug,
     )
-    logger = MetricLogger(cfg.rundir, cfg)
-    fingerprint = config_fingerprint(to_dict(cfg.model))
+    logger = MetricLogger(cfg.rundir, cfg, use_wandb=cfg.use_wandb)
+    # fingerprint covers only fields that change the math/parameters —
+    # runtime implementation knobs (kernel choice, remat, unroll) may vary
+    # freely between save and resume
+    _impl_knobs = ("attn_impl", "norm_impl", "remat", "scan_unroll")
+    fingerprint = config_fingerprint(
+        {k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs}
+    )
 
     key = jax.random.PRNGKey(cfg.seed)
     state = init_state(cfg, mesh, tx, key)

@@ -1,0 +1,67 @@
+"""Profile one training step of any named config on the current devices.
+
+    python scripts/profile_step.py --config=openwebtext --outdir=/tmp/prof \
+        [--set model.n_layer=4 ...]
+
+Writes a TensorBoard-compatible trace (xplane) to <outdir>; inspect with
+tensorboard-plugin-profile. Equivalent of the reference's --debug step-0
+trace (/root/reference/src/train.py:205-211) as a standalone tool, usable
+without starting a run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--outdir", required=True)
+    ap.add_argument("--batch", type=int, default=None, help="override global batch")
+    ap.add_argument("--steps", type=int, default=3, help="steps inside the trace")
+    ap.add_argument("--set", nargs="*", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args()
+
+    from launch import apply_overrides
+    from midgpt_tpu.config import get_config
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+    from jax.sharding import PartitionSpec as P
+
+    cfg = apply_overrides(get_config(args.config), args.set)
+    if args.batch is not None:
+        cfg = dataclasses.replace(cfg, batch_size=args.batch, g_accum_iters=1)
+
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tx, mesh)
+
+    t = cfg.model.block_size
+    g, b = cfg.g_accum_iters, cfg.batch_size // cfg.g_accum_iters
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg.model.vocab_size, size=(g, b, t), dtype=np.int32)
+    y = rng.integers(0, cfg.model.vocab_size, size=(g, b, t), dtype=np.int32)
+    spec = P(None, ("replica", "fsdp"), "sequence")
+    xg, yg = make_global_array(x, mesh, spec), make_global_array(y, mesh, spec)
+    key = jax.random.PRNGKey(1)
+
+    # warmup/compile outside the trace
+    state, loss = step(state, xg, yg, key)
+    jax.block_until_ready(loss)
+
+    with jax.profiler.trace(args.outdir):
+        for _ in range(args.steps):
+            state, loss = step(state, xg, yg, key)
+        jax.block_until_ready(loss)
+    print(f"traced {args.steps} steps of {args.config} -> {args.outdir}")
+
+
+if __name__ == "__main__":
+    main()

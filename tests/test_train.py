@@ -117,3 +117,21 @@ def test_resume_continuity(tmp_path):
     assert abs(final_b["val_loss"] - final_full["val_loss"]) < 0.15, (
         f"resume diverged: {final_b['val_loss']} vs {final_full['val_loss']}"
     )
+
+
+@pytest.mark.slow
+def test_llama_family_trains_sharded(tmp_path):
+    """The Llama-style path (SwiGLU + GQA) must compose with the full
+    DP x FSDP x SP x TP mesh — exercises the w_gate partition rule and
+    grouped-KV sharding through a real train step."""
+    cfg = _tiny_cfg(
+        tmp_path,
+        model=ModelConfig(
+            block_size=32, vocab_size=64, n_layer=2, n_head=4, n_kv_head=2,
+            n_embd=64, dropout=0.0, mlp="swiglu", mlp_ratio=2.0,
+            attn_impl="naive", remat="full",
+        ),
+        max_steps=20, lr_decay_steps=20, eval_interval=10,
+    )
+    final = train(cfg)
+    assert final["loss"] < 3.0, f"loss did not decrease: {final}"
