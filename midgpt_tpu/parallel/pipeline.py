@@ -1,0 +1,146 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a
+``'pipeline'`` mesh axis.
+
+Absent from the reference (SURVEY.md 2.6: no PP anywhere); provided here as
+the TPU-native building block. Design (idiomatic JAX, no hand-scheduled
+backward):
+
+- Layer-stacked params (leading ``[L, ...]`` axis, the same layout the
+  scan-over-layers model already uses) are split into S contiguous stage
+  chunks; inside ``jax.shard_map`` each device along the ``'pipeline'``
+  axis holds ``L/S`` layers.
+- Microbatches stream through the ring: one ``lax.scan`` over
+  ``M + S - 1`` ticks; every tick each stage runs its layer chunk on its
+  current activation and hands the result to the next stage with
+  ``lax.ppermute`` (neighbor-only ICI traffic). Stage 0 injects a fresh
+  microbatch per tick; the last stage banks its outputs.
+- The backward pass is DERIVED BY AD: ppermute's transpose is the reverse
+  permute, scan's transpose runs the ticks backwards — exactly the
+  reverse-schedule GPipe backward, with whole-stage rematerialization via
+  ``jax.checkpoint`` around the stage body.
+- The (S-1)-tick bubble is the standard GPipe cost: utilization
+  M / (M + S - 1); choose M >= 4*S to keep it small.
+
+This module is schedule-complete and differentiable; wiring it into the
+GPT trainer (embedding/head placement, composing with the fsdp/tensor
+axes via partial-auto shard_map) is the integration step tracked in
+SURVEY.md §7 stage extensions.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+StageFn = tp.Callable[[tp.Any, Array], Array]
+"""(stage_params, activation [Bm, ...]) -> activation [Bm, ...]; applies
+one stage's worth of layers (e.g. a lax.scan over the local layer chunk)."""
+
+
+def pipeline_forward(
+    stacked_params: tp.Any,  # pytree, every leaf [L, ...]
+    x: Array,  # [M, Bm, ...] microbatched input activations
+    stage_fn: StageFn,
+    mesh: Mesh,
+    *,
+    axis: str = "pipeline",
+    remat: bool = True,
+) -> Array:
+    """Run ``x`` through all L layers, pipelined over the ``axis`` stages.
+
+    Returns [M, Bm, ...] outputs (same sharding layout as ``x``).
+    """
+    n_stages = mesh.shape[axis]
+    m = x.shape[0]
+    leaves = jax.tree.leaves(stacked_params)
+    assert leaves, "stacked_params must contain at least one array"
+    n_layer = leaves[0].shape[0]
+    assert n_layer % n_stages == 0, (
+        f"n_layer {n_layer} not divisible by {n_stages} pipeline stages"
+    )
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def per_stage(params_local, x_local):
+        # params_local leaves: [L/S, ...] (shard_map strips the stage dim)
+        # x_local: [M, Bm, ...] (replicated across the pipeline axis)
+        s_idx = jax.lax.axis_index(axis)
+        n_ticks = m + n_stages - 1
+        zero_act = jnp.zeros_like(x_local[0])
+
+        def tick(carry, t):
+            recv, outputs = carry
+            # stage 0 pulls microbatch t (clamped; masked off after M)
+            mb = jax.lax.dynamic_index_in_dim(
+                x_local, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+            )
+            in_act = jnp.where(s_idx == 0, mb, recv)
+            # active window for this stage: t in [s_idx, s_idx + M)
+            active = jnp.logical_and(t >= s_idx, t < s_idx + m)
+            out_act = body(params_local, in_act)
+            out_act = jnp.where(active, out_act, zero_act)
+            # bank the last stage's finished microbatch (m_done = t - (S-1));
+            # non-banking ticks write back the existing slot unchanged
+            m_done = t - (n_stages - 1)
+            is_last = s_idx == n_stages - 1
+            do_bank = jnp.logical_and(is_last, m_done >= 0)
+            slot = jnp.clip(m_done, 0, m - 1)
+            prev = jax.lax.dynamic_index_in_dim(
+                outputs, slot, axis=0, keepdims=False
+            )
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(do_bank, out_act, prev), slot, axis=0
+            )
+            # hand activations to the next stage (ring; last->0 edge is
+            # ignored because stage 0 reads the fresh microbatch instead)
+            sent = jax.lax.ppermute(
+                out_act,
+                axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return (sent, outputs), None
+
+        outputs0 = jnp.zeros((m,) + x_local.shape[1:], x_local.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero_act, outputs0), jnp.arange(n_ticks)
+        )
+        # only the last stage holds real outputs; share them around the ring
+        outputs = jax.lax.psum(
+            jnp.where(s_idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis,
+        )
+        return outputs
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),  # stage dim = leading
+        P(),  # input replicated over the pipeline axis
+    )
+    return jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x)
+
+
+def stage_scan_fn(block_fn: tp.Callable[[tp.Any, Array], Array]) -> StageFn:
+    """Lift a single-layer ``block_fn(params_1layer, x) -> x`` into a
+    StageFn that scans over the stage's local layer chunk — the same
+    scan-over-layers pattern the full model uses (models/gpt.py)."""
+
+    def stage(params_local, x):
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+
+        out, _ = jax.lax.scan(body, x, params_local)
+        return out
+
+    return stage

@@ -1,8 +1,9 @@
-"""Genuine multi-process distributed test: two OS processes join a JAX
-coordination service on CPU and run the per-process data-feed +
-global-array assembly path (parity target: the reference's multihost
-mechanisms, /root/reference/launch.py:22-23 jax.distributed.initialize +
-src/sharding.py:33-42 per-host batch assembly)."""
+"""Genuine multi-process distributed tests: two OS processes join a JAX
+coordination service on CPU and run (a) the per-process data-feed +
+global-array assembly path and (b) a full train() with shared-rundir
+checkpointing (parity target: the reference's multihost mechanisms,
+/root/reference/launch.py:22-23 jax.distributed.initialize +
+src/sharding.py:33-42 per-host batch assembly + src/train.py:127-225)."""
 
 import os
 import socket
@@ -50,6 +51,86 @@ sync_global_devices("end")  # (parity: launch.py:69-70)
 print(f"OK proc={proc_id} total={int(total)}")
 """
 
+_TRAIN_WORKER = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+proc_id = int(sys.argv[1])
+jax.distributed.initialize(
+    coordinator_address=sys.argv[2], num_processes=2, process_id=proc_id
+)
+
+from midgpt_tpu.config import ExperimentConfig, MeshConfig, ModelConfig
+from midgpt_tpu.train import train
+
+cfg = ExperimentConfig(
+    model=ModelConfig(
+        block_size=32, vocab_size=64, n_layer=2, n_head=2, n_embd=64,
+        dropout=0.0, attn_impl="naive", remat="none",
+    ),
+    rundir=sys.argv[3],
+    data_dir=sys.argv[4],
+    learning_rate=1e-2, min_lr=1e-3, warmup_steps=5,
+    lr_decay_steps=20, max_steps=20,
+    batch_size=8, g_accum_iters=2,
+    eval_interval=10, eval_batches=2, log_interval=5,
+    mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
+)
+final = train(cfg)
+print(f"FINAL proc={proc_id} val={final['val_loss']:.6f}")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_workers(worker_path: str, argv_tail_fn, attempts: int = 2):
+    """Launch the 2-process worker pair; retry once with a fresh
+    coordinator port (the free-port probe can race other processes under a
+    loaded full-suite run). ``argv_tail_fn(attempt)`` supplies per-attempt
+    args so retries never reuse stateful paths (e.g. a rundir with a
+    half-written checkpoint)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_NUM_PROCESSES", None)
+
+    last = None
+    for attempt in range(attempts):
+        coord = f"localhost:{_free_port()}"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker_path, str(i), coord,
+                 *argv_tail_fn(attempt)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=repo_root,
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = [p.communicate(timeout=600)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            for p in procs:  # a wedged sibling must not outlive the test
+                p.kill()
+            outs = [p.communicate()[0] for p in procs]
+            last = "timeout:\n" + "\n".join(o[-2000:] for o in outs)
+            continue
+        if all(p.returncode == 0 for p in procs):
+            return outs
+        last = "\n".join(
+            f"-- proc {i} rc={p.returncode} --\n{out[-3000:]}"
+            for i, (p, out) in enumerate(zip(procs, outs))
+        )
+    raise AssertionError(f"workers failed after {attempts} attempts:\n{last}")
+
 
 @pytest.mark.slow
 def test_two_process_data_feed(tmp_path):
@@ -60,32 +141,12 @@ def test_two_process_data_feed(tmp_path):
     token_path = str(tmp_path / "train.bin")
     write_tokens(token_path, np.arange(10_000) % 251)
 
-    port = _free_port()
-    coord = f"localhost:{port}"
     worker = str(tmp_path / "worker.py")
     with open(worker, "w") as f:
         f.write(_WORKER)
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("JAX_NUM_PROCESSES", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), coord, token_path],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, cwd=repo_root,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    outs = _run_workers(worker, lambda attempt: [token_path])
+    for i, out in enumerate(outs):
         assert f"OK proc={i}" in out, out
     # both processes computed the same global sum
     t0 = [l for l in outs[0].splitlines() if l.startswith("OK")][0].split("total=")[1]
@@ -93,9 +154,46 @@ def test_two_process_data_feed(tmp_path):
     assert t0 == t1
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+@pytest.mark.slow
+def test_two_process_full_train(tmp_path):
+    """Full train() across two processes: per-process data shards, a global
+    mesh over both, distributed Orbax checkpointing to a shared rundir."""
+    import numpy as np
+
+    from midgpt_tpu.data import write_tokens
+
+    data_dir = str(tmp_path / "data")
+    os.makedirs(data_dir)
+    rng = np.random.default_rng(0)
+    base = np.tile(np.arange(64), 2000)
+    toks = np.where(rng.random(base.shape) < 0.05,
+                    rng.integers(0, 64, size=base.shape), base)
+    write_tokens(os.path.join(data_dir, "train.bin"), toks)
+    write_tokens(os.path.join(data_dir, "val.bin"), toks[:20_000])
+
+    worker = str(tmp_path / "train_worker.py")
+    with open(worker, "w") as f:
+        f.write(_TRAIN_WORKER)
+
+    # fresh rundir per attempt: a retry must not resume from a previous
+    # attempt's checkpoint
+    rundirs = [str(tmp_path / f"run{i}") for i in range(2)]
+    used = []
+
+    def tail(attempt):
+        used.append(rundirs[attempt])
+        return [rundirs[attempt], data_dir]
+
+    outs = _run_workers(worker, tail)
+    rundir = used[-1]
+    finals = [
+        [l for l in out.splitlines() if l.startswith("FINAL")][0]
+        for out in outs
+    ]
+    # the global val loss must agree across processes
+    assert finals[0].split("val=")[1] == finals[1].split("val=")[1], finals
+    # shared-rundir checkpoint written
+    from midgpt_tpu.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(rundir, save_interval_steps=10)
+    assert ckpt.latest_step() == 19
