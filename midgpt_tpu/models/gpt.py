@@ -284,6 +284,26 @@ class Block:
         return x, cache_k, cache_v
 
 
+def embed_tokens(wte: Embedding, tokens: Array) -> Array:
+    """Token embedding that stays SPMD-friendly under tensor parallelism.
+
+    When the vocab dim is tensor-sharded (GPT_PARAM_RULES), a jnp.take
+    whose indexed dim is sharded forces SPMD into involuntary full
+    rematerialization; the TPU-native embedding under TP is a one-hot
+    contraction — GSPMD turns the vocab-sharded einsum into a partial
+    matmul + psum over 'tensor', and the MXU eats it. With an unsharded
+    vocab the plain gather is cheaper. Shared by the batched forward and
+    the KV-cache decode path."""
+    mesh = current_mesh()
+    if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+        one_hot = jax.nn.one_hot(
+            tokens, wte.weight.shape[0], dtype=wte.weight.dtype
+        )
+        one_hot = shard_act(one_hot, "batch", "seq", "vocab")
+        return one_hot @ wte.weight
+    return wte(tokens)
+
+
 @module
 class GPT:
     """The full model. ``blocks`` leaves carry a leading n_layer axis."""
@@ -339,21 +359,7 @@ class GPT:
             scan_keys = jax.random.split(block_key, cfg.n_layer)
 
         with jax.named_scope("gpt"):
-            # When the vocab dim is tensor-sharded (GPT_PARAM_RULES), a
-            # jnp.take whose indexed dim is sharded forces SPMD into
-            # involuntary full rematerialization. The TPU-native embedding
-            # under TP is a one-hot contraction: GSPMD turns the sharded-V
-            # einsum into a partial matmul + psum over 'tensor', and the MXU
-            # eats it. With an unsharded vocab the plain gather is cheaper.
-            mesh = current_mesh()
-            if mesh is not None and mesh.shape.get("tensor", 1) > 1:
-                one_hot = jax.nn.one_hot(
-                    tokens, cfg.vocab_size, dtype=self.wte.weight.dtype
-                )
-                one_hot = shard_act(one_hot, "batch", "seq", "vocab")
-                h = one_hot @ self.wte.weight  # [B, T, D]
-            else:
-                h = self.wte(tokens)  # [B, T, D]
+            h = embed_tokens(self.wte, tokens)  # [B, T, D]
             h = dropout(h, cfg.dropout, drop_key, deterministic)
             h = shard_act(h, "batch", "seq", "embed")
 
@@ -417,7 +423,7 @@ def decode_step(
     sin_np, cos_np = rope_tables(cfg.head_dim, t_max, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
-    h = model.wte(tokens[:, None])  # [B, 1, D]
+    h = embed_tokens(model.wte, tokens[:, None])  # [B, 1, D]
 
     def body(carry, layer):
         x = carry

@@ -65,3 +65,35 @@ def generate(
         length=max_new_tokens,
     )
     return jnp.transpose(toks)  # [B, N]
+
+
+def make_sampler(
+    max_new_tokens: int,
+    *,
+    mesh=None,
+    temperature: float = 1.0,
+    top_k: tp.Optional[int] = None,
+    cache_dtype=jnp.bfloat16,
+):
+    """A jitted ``(model, prompt, key) -> tokens`` sampler.
+
+    With ``mesh``, generation runs under the mesh's axis rules: restored
+    params keep their TP/FSDP shardings and GSPMD distributes the decode
+    matmuls + KV cache — the multi-chip serving path for models too big
+    for one chip (absent from the reference, whose sampler is strictly
+    single-process full-replication, sample.py:177-182)."""
+    from midgpt_tpu.parallel.sharding import axis_rules
+
+    def fn(model: GPT, prompt: Array, key: Array) -> Array:
+        with axis_rules(mesh):  # axis_rules(None) is an explicit no-op scope
+            return generate(
+                model,
+                prompt,
+                max_new_tokens,
+                key=key,
+                temperature=temperature,
+                top_k=top_k,
+                cache_dtype=cache_dtype,
+            )
+
+    return jax.jit(fn)

@@ -60,7 +60,7 @@ def main() -> None:
     from midgpt_tpu.checkpoint import Checkpointer
     from midgpt_tpu.config import from_dict
     from midgpt_tpu.pytree import cast_floating
-    from midgpt_tpu.sampling import generate
+    from midgpt_tpu.sampling import make_sampler
 
     with open(os.path.join(args.ckpt_dir, "config.json")) as f:
         cfg = from_dict(json.load(f))
@@ -74,6 +74,27 @@ def main() -> None:
         return GPT.init(key, cfg.model)
 
     abstract_params = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+
+    # multi-chip: restore straight into the config's mesh shardings and
+    # decode distributed (the reference replicates fully, sample.py:177-182)
+    mesh = None
+    if jax.device_count() > 1:
+        from midgpt_tpu.models.gpt import GPT_PARAM_RULES
+        from midgpt_tpu.parallel.mesh import create_mesh
+        from midgpt_tpu.parallel.sharding import param_shardings
+
+        try:
+            mesh = create_mesh(cfg.mesh)
+        except (AssertionError, ValueError):
+            mesh = None  # config mesh doesn't fit this host's devices
+        if mesh is not None:
+            shardings = param_shardings(mesh, abstract_params, GPT_PARAM_RULES)
+            abstract_params = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                abstract_params,
+                shardings,
+            )
+
     ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
     items, meta = ckpt.restore({"params": abstract_params})
     print(f"restored step {meta['step']} from {args.ckpt_dir}")
@@ -88,14 +109,13 @@ def main() -> None:
     prompt = np.tile(prompt[None, :], (args.num_samples, 1))
 
     model = cast_floating(model, jnp.bfloat16)
-    toks = generate(
-        model,
-        jnp.asarray(prompt),
+    sampler = make_sampler(
         args.max_new_tokens,
-        key=jax.random.PRNGKey(args.seed),
+        mesh=mesh,
         temperature=args.temperature,
         top_k=args.top_k,
     )
+    toks = sampler(model, jnp.asarray(prompt), jax.random.PRNGKey(args.seed))
     for i in range(args.num_samples):
         print("-" * 40)
         print(start + decode(np.asarray(toks[i])))

@@ -100,3 +100,29 @@ def test_generate_gqa_variant():
         model, prompt, 5, key=jax.random.PRNGKey(1), cache_dtype=jnp.float32
     )
     assert out.shape == (2, 5)
+
+
+def test_sharded_sampler_matches_unsharded(mesh8):
+    """make_sampler under the 8-device mesh (TP-sharded params + cache)
+    must reproduce single-device greedy generation exactly."""
+    from jax.sharding import NamedSharding
+
+    from midgpt_tpu.models.gpt import GPT_PARAM_RULES
+    from midgpt_tpu.parallel.sharding import param_shardings
+    from midgpt_tpu.sampling import make_sampler
+
+    model = GPT.init(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size)
+    key = jax.random.PRNGKey(2)
+
+    ref = generate(
+        model, prompt, 12, key=key, temperature=0.0, cache_dtype=jnp.float32
+    )
+
+    shardings = param_shardings(mesh8, model, GPT_PARAM_RULES)
+    sharded_model = jax.tree.map(jax.device_put, model, shardings)
+    sampler = make_sampler(
+        12, mesh=mesh8, temperature=0.0, cache_dtype=jnp.float32
+    )
+    out = sampler(sharded_model, prompt, key)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
