@@ -90,16 +90,13 @@ class RMSNorm:
         return RMSNorm(weight=w, eps=eps, impl=impl)
 
     def __call__(self, x: Array) -> Array:
+        from midgpt_tpu.utils.platform import is_tpu_backend
+
         with jax.named_scope("rmsnorm"):
             if (
                 self.impl == "fused"
                 and x.shape[-1] % 128 == 0
-                # same platform probe as the attention dispatch: "tpu"
-                # natively, device_kind "TPU v5..." through the axon tunnel
-                and any(
-                    "tpu" in f"{d.platform} {d.device_kind}".lower()
-                    for d in jax.devices()
-                )
+                and is_tpu_backend()
             ):
                 from midgpt_tpu.ops.fused_norm import fused_rms_norm
 

@@ -86,12 +86,10 @@ def attention(
       flash - Pallas blockwise online-softmax kernel
     """
     if impl == "auto":
-        # platform is "tpu" natively, "axon" through the tunnel (kind "TPU v5...")
-        on_tpu = any(
-            "tpu" in f"{d.platform} {d.device_kind}".lower() for d in jax.devices()
-        )
+        from midgpt_tpu.utils.platform import is_tpu_backend
+
         use_flash = (
-            on_tpu
+            is_tpu_backend()
             and (dropout_rate == 0.0 or deterministic)
             and q.shape[2] >= 128
             and q.shape[2] % 128 == 0

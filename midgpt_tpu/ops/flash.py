@@ -268,7 +268,7 @@ def _bwd_dkv_kernel(
 
 def _flash_backward(
     q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
-    *, causal: bool, bq: int, bk: int,
+    *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None,
 ) -> tp.Tuple[Array, Array, Array]:
     b, h, t, c = q.shape
     hkv = k.shape[1]
@@ -277,10 +277,15 @@ def _flash_backward(
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
 
-    # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA
+    # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA.
+    # When the caller also consumes lse (flash_attention_lse), its
+    # cotangent folds in exactly here: dL/dz_ij = p_ij (dp_ij - delta_i
+    # + dlse_i), since dlse_i/dz_ij = p_ij — so delta_eff = delta - dlse.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, T, 1]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -357,7 +362,6 @@ def _flash_backward(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
     q: Array,
     k: Array,
@@ -366,24 +370,49 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> Array:
-    out, _ = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+    """Flash attention output only — delegates to flash_attention_lse (the
+    dropped lse's cotangent instantiates to zeros, making the backward's
+    ``delta - dlse`` fold a no-op), so there is a single VJP pair to
+    maintain."""
+    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_lse(
+    q: Array,
+    k: Array,
+    v: Array,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> tp.Tuple[Array, Array]:
+    """Flash attention returning (out [B,H,T,C], lse [B,H,T]).
+
+    The lse output is differentiable — its cotangent folds into the
+    backward kernels as ``delta - dlse`` (see _flash_backward) — which is
+    what lets ring attention (midgpt_tpu.parallel.ring) run this kernel
+    per hop and still autodiff through the streaming LSE merge."""
     out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
-    return out, (q, k, v, out, lse)
+    return out, lse[..., 0]
 
 
-def _vjp_bwd(causal, block_q, block_k, residuals, do):
+def _lse_vjp_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _lse_vjp_bwd(causal, block_q, block_k, residuals, cts):
     q, k, v, out, lse = residuals
+    do, dlse = cts
     dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, do, causal=causal, bq=block_q, bk=block_k
+        q, k, v, out, lse, do,
+        causal=causal, bq=block_q, bk=block_k, dlse=dlse[..., None],
     )
     return dq, dk, dv
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
 
 
 def flash_attention_reference(q, k, v, causal=True):

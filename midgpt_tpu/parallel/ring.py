@@ -16,10 +16,13 @@ memory); this is the long-context mechanism the rebuild owes. Design:
   fully if j < i, causally-masked if j == i, not at all if j > i (the hop
   is skipped with a -inf lse so the merge ignores it).
 
-The per-chunk-pair math here is the naive oracle (differentiable end to
-end through ppermute's transpose — bwd runs the ring in reverse
-automatically). Fusing the Pallas flash kernel into the ring (needs a
-custom ring VJP because the merge consumes lse) is tracked as a perf item.
+Two per-chunk-pair backends, both differentiable end to end through
+ppermute's transpose (bwd runs the ring in reverse automatically):
+- naive oracle (``_chunk_attention``) — reference-parity math;
+- Pallas flash (``_chunk_flash``, default on TPU) — each hop runs
+  ``flash_attention_lse``; its lse output is differentiable (the
+  cotangent folds into the kernel backward as ``delta - dlse``), so no
+  hand-written ring VJP is needed and per-hop memory stays O(chunk).
 """
 
 from __future__ import annotations
@@ -93,22 +96,44 @@ def _merge(o1, lse1, o2, lse2):
     return out, lse
 
 
-def _ring_body(q, k, v, axis_name: str):
+def _chunk_flash(q, k, v, causal: bool):
+    """One (q-chunk, kv-chunk) pair through the Pallas flash kernel —
+    no Tq x Tk materialization, so per-hop memory stays O(chunk). Returns
+    the same (normalized out f32, lse f32) contract as _chunk_attention."""
+    from midgpt_tpu.ops.flash import flash_attention_lse
+
+    out, lse = flash_attention_lse(q, k, v, causal)
+    return out.astype(jnp.float32), lse
+
+
+def _ring_body(q, k, v, axis_name: str, use_flash: bool):
     """Per-device program: local chunks in, attention output chunk out."""
     s = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % s) for i in range(s)]  # send kv to the next device
 
     # hop 0: own chunk (diagonal -> causal)
-    out, lse = _chunk_attention(q, k, v, jnp.asarray(1, jnp.int32))
+    if use_flash:
+        out, lse = _chunk_flash(q, k, v, causal=True)
+    else:
+        out, lse = _chunk_attention(q, k, v, jnp.asarray(1, jnp.int32))
 
     def hop(r, carry):
         out, lse, k, v = carry
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
         src = (idx - r) % s  # chunk index now held
-        mode = jnp.where(src < idx, 2, 0).astype(jnp.int32)  # full or skip
-        o_r, lse_r = _chunk_attention(q, k, v, mode)
+        if use_flash:
+            # compute the full-visibility pair, then gate the skip hops
+            # (src > idx) out of the merge with lse = -inf; the flash
+            # kernel's causal flag must stay static
+            o_r, lse_r = _chunk_flash(q, k, v, causal=False)
+            keep = src < idx
+            lse_r = jnp.where(keep, lse_r, -jnp.inf)
+            o_r = jnp.where(keep, o_r, 0.0)
+        else:
+            mode = jnp.where(src < idx, 2, 0).astype(jnp.int32)  # full|skip
+            o_r, lse_r = _chunk_attention(q, k, v, mode)
         out, lse = _merge(out, lse, o_r, lse_r)
         return out, lse, k, v
 
@@ -125,12 +150,23 @@ def ring_attention(
     axis_name: str = "sequence",
     batch_axes: tp.Tuple[str, ...] = ("replica", "fsdp"),
     head_axis: tp.Optional[str] = "tensor",
+    use_flash: tp.Optional[bool] = None,
 ) -> Array:
     """Causal ring attention over the mesh. Differentiable (autodiff
-    transposes the ppermute ring). T must divide by the axis size."""
+    transposes the ppermute ring). T must divide by the axis size.
+
+    use_flash: run each hop through the Pallas flash kernel (O(chunk)
+    memory per hop — the true long-context path) instead of the naive
+    chunk-pair math. None = auto: flash on TPU when the local chunk is
+    lane-aligned."""
     s = mesh.shape[axis_name]
     t = q.shape[2]
     assert t % s == 0, f"T={t} not divisible by sequence axis {s}"
+    if use_flash is None:
+        from midgpt_tpu.ops.flash import DEFAULT_BLOCK_Q
+        from midgpt_tpu.utils.platform import is_tpu_backend
+
+        use_flash = is_tpu_backend() and (t // s) % DEFAULT_BLOCK_Q == 0
 
     # only shard batch/head dims over axes that actually divide them
     def fit(dim: int, axes: tp.Sequence[str]) -> tp.Tuple[str, ...]:
@@ -146,7 +182,9 @@ def ring_attention(
     h_axes = fit(k.shape[1], (head_axis,) if head_axis else ())
     spec = P(b_axes if b_axes else None, h_axes if h_axes else None, axis_name, None)
     fn = jax.shard_map(
-        functools.partial(_ring_body, axis_name=axis_name),
+        functools.partial(
+            _ring_body, axis_name=axis_name, use_flash=use_flash
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -1,0 +1,15 @@
+"""Backend probes shared by the kernel dispatch sites."""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu_backend() -> bool:
+    """True when the default backend is a TPU. The platform string is
+    "tpu" natively but e.g. "axon" through a tunnel, where only the
+    device_kind ("TPU v5 lite", ...) gives it away — hence the combined
+    probe."""
+    return any(
+        "tpu" in f"{d.platform} {d.device_kind}".lower() for d in jax.devices()
+    )

@@ -25,3 +25,17 @@ def mesh8():
     from midgpt_tpu.parallel.mesh import create_mesh
 
     return create_mesh(MeshConfig(replica=1, fsdp=2, sequence=2, tensor=2))
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Run Pallas kernels through the CPU interpreter (the tests' only way
+    to execute TPU kernels without hardware)."""
+    import functools
+
+    from jax.experimental import pallas as pl
+
+    monkeypatch.setattr(
+        pl, "pallas_call", functools.partial(pl.pallas_call, interpret=True)
+    )
+    yield

@@ -16,11 +16,7 @@ from jax.experimental import pallas as pl
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    orig = pl.pallas_call
-    monkeypatch.setattr(
-        pl, "pallas_call", functools.partial(orig, interpret=True)
-    )
+def _interpret_mode(pallas_interpret):
     yield
 
 
@@ -85,3 +81,44 @@ def test_flash_rejects_ragged_seq():
     q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 1, 1, 192, 32)
     with pytest.raises(AssertionError):
         flash_mod.flash_attention(q, k, v, True, 128, 128)
+
+
+def test_flash_lse_outputs_and_grads():
+    """flash_attention_lse: lse matches the f32 oracle, and a loss that
+    consumes BOTH outputs differentiates correctly (the lse cotangent is
+    folded into the backward kernels as delta - dlse)."""
+    import math
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 2, 2, 256, 32)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def oracle(q, k, v):
+        z = jnp.einsum("bhqc,bhjc->bhqj", q, k).astype(jnp.float32)
+        mask = jnp.tril(jnp.ones(z.shape[-2:], bool))
+        z = jnp.where(mask, z, -jnp.inf) * 1.0
+        z = z * scale
+        lse = jax.scipy.special.logsumexp(z, axis=-1)
+        out = jnp.einsum(
+            "bhqj,bhjc->bhqc", jax.nn.softmax(z, axis=-1).astype(v.dtype), v
+        )
+        return out, lse
+
+    out_f, lse_f = flash_mod.flash_attention_lse(q, k, v, True, 128, 128)
+    out_o, lse_o = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_o), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_f), np.asarray(lse_o), atol=2e-5)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_mod.flash_attention_lse(q, k, v, True, 128, 128)
+        return jnp.sum(out**2) + jnp.sum(jnp.sin(lse))
+
+    def loss_oracle(q, k, v):
+        out, lse = oracle(q, k, v)
+        return jnp.sum(out**2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, go, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )

@@ -13,9 +13,7 @@ from midgpt_tpu.models.layers import RMSNorm
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    orig = pl.pallas_call
-    monkeypatch.setattr(pl, "pallas_call", functools.partial(orig, interpret=True))
+def _interpret_mode(pallas_interpret):
     yield
 
 
