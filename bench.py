@@ -37,6 +37,10 @@ def _run_config(remat: str, batch: int):
         g_accum_iters=1,
         model=dataclasses.replace(cfg.model, attn_impl="auto", remat=remat),
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
+        # head+xent computed T-chunk-wise: the [B,T,V] f32 logits (3.3 GB
+        # at this config) never materialize, which is what makes the
+        # remat='none' rung fit in HBM
+        loss_chunk=128,
     )
 
     mesh = create_mesh(cfg.mesh)

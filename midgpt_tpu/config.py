@@ -125,6 +125,10 @@ class ExperimentConfig:
     compute_dtype: str = "bfloat16"
     seed: int = 0
     data_seed: int = 1234  # seeded loader (fixes train.py:60 nondeterminism)
+    # T-chunk size for chunked cross-entropy (ops/loss.py): the [B,T,V] f32
+    # logits never materialize. None = dense loss (reference parity path);
+    # ignored (dense used) when the sequence axis is sharded.
+    loss_chunk: tp.Optional[int] = None
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     use_wandb: bool = False  # wandb.init on proc 0 (parity: launch.py:68)
     debug: bool = False

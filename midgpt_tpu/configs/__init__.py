@@ -42,6 +42,7 @@ def openwebtext() -> ExperimentConfig:
         batch_size=2048, g_accum_iters=16,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        loss_chunk=128,
     )
 
 
@@ -72,6 +73,7 @@ def openwebtext_xl() -> ExperimentConfig:
         batch_size=1024, g_accum_iters=1,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        loss_chunk=128,
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
     )
 
@@ -103,6 +105,7 @@ def llama_7b() -> ExperimentConfig:
         batch_size=512, g_accum_iters=1,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        loss_chunk=128,
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
     )
 

@@ -339,14 +339,14 @@ class GPT:
             config=cfg,
         )
 
-    def __call__(
+    def hidden(
         self,
         tokens: Array,  # [B, T] int32
         *,
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
         attn_impl: tp.Optional[str] = None,
-    ) -> Array:  # [B, T, V] logits in compute dtype
+    ) -> Array:  # [B, T, D] final (ln_f-normalized) hidden states
         cfg = self.config
         impl = attn_impl if attn_impl is not None else cfg.attn_impl
         b, t = tokens.shape
@@ -385,14 +385,30 @@ class GPT:
             h, _ = jax.lax.scan(
                 body, h, (self.blocks, scan_keys), unroll=cfg.scan_unroll
             )
-            h = self.ln_f(h)
-            head_w = (
-                self.wte.weight.T.astype(h.dtype)
-                if self.lm_head is None
-                else self.lm_head.weight.astype(h.dtype)
-            )
-            logits = h @ head_w  # [B, T, V]
-            return shard_act(logits, "batch", "seq", "vocab")
+            return self.ln_f(h)
+
+    def head_weight(self, dtype) -> Array:
+        """[D, V] lm-head weight in ``dtype`` (the shared wte array when
+        init-only-tied/tied, SURVEY.md 2.3)."""
+        return (
+            self.wte.weight.T.astype(dtype)
+            if self.lm_head is None
+            else self.lm_head.weight.astype(dtype)
+        )
+
+    def __call__(
+        self,
+        tokens: Array,  # [B, T] int32
+        *,
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+        attn_impl: tp.Optional[str] = None,
+    ) -> Array:  # [B, T, V] logits in compute dtype
+        h = self.hidden(
+            tokens, key=key, deterministic=deterministic, attn_impl=attn_impl
+        )
+        logits = h @ self.head_weight(h.dtype)  # [B, T, V]
+        return shard_act(logits, "batch", "seq", "vocab")
 
 
 @module
@@ -437,12 +453,7 @@ def decode_step(
         body, h, (model.blocks, cache.k, cache.v), unroll=cfg.scan_unroll
     )
     h = model.ln_f(h)
-    head_w = (
-        model.wte.weight.T.astype(h.dtype)
-        if model.lm_head is None
-        else model.lm_head.weight.astype(h.dtype)
-    )
-    logits = (h @ head_w)[:, 0, :]  # [B, V]
+    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
     return logits, KVCache(k=new_k, v=new_v)
 
 

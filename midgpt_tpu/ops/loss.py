@@ -1,0 +1,64 @@
+"""Chunked softmax cross-entropy: the lm-head projection and the loss
+computed T-chunk by T-chunk, so the full ``[B, T, V]`` float32 logits
+tensor never materializes in HBM.
+
+The reference (and our dense path) computes all logits, casts to f32, and
+calls softmax xent (/root/reference/src/train.py:76-77) — at B=16, T=1024,
+V=50304 that is a 3.3 GB f32 intermediate, and it is what makes
+remat='none' infeasible at the 124M bench config. Here a ``lax.scan`` over
+T-chunks computes ``[B, tc, V]`` logits per step inside a
+``jax.checkpoint`` body (recomputed in the backward), reducing peak loss
+memory by T/tc while keeping the math bit-identical in structure: logits
+in f32, logsumexp-minus-target-logit, mean over all tokens.
+
+Sharding note: the scan iterates over the T axis, so this path requires
+the sequence axis to be UNSHARDED (callers gate on mesh['sequence'] == 1;
+under sequence parallelism per-step slicing of a sharded axis would insert
+collectives every chunk). Batch and vocab sharding compose fine — the
+per-chunk matmul + logsumexp reduce over a tensor-sharded V become a
+partial matmul + psum under GSPMD exactly like the dense path.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def chunked_softmax_xent(
+    h: Array,  # [B, T, D] final hidden states (compute dtype)
+    head_w: Array,  # [D, V] lm-head weight (compute dtype)
+    targets: Array,  # [B, T] int
+    *,
+    chunk_t: int = 128,
+) -> Array:
+    """Mean cross-entropy over all B*T tokens, identical math to
+    ``softmax_cross_entropy_with_integer_labels(h @ head_w -> f32, y)``."""
+    b, t, d = h.shape
+    assert t % chunk_t == 0, f"T={t} not divisible by chunk_t={chunk_t}"
+    nc = t // chunk_t
+    # [nc, B, tc, ...] so scan slices the leading (iteration) axis
+    h_c = jnp.moveaxis(h.reshape(b, nc, chunk_t, d), 1, 0)
+    y_c = jnp.moveaxis(targets.reshape(b, nc, chunk_t), 1, 0)
+
+    @jax.checkpoint
+    def body(acc, xs):
+        h_i, y_i = xs  # [B, tc, D], [B, tc]
+        z = (h_i @ head_w).astype(jnp.float32)  # [B, tc, V]
+        lse = jax.scipy.special.logsumexp(z, axis=-1)  # [B, tc]
+        # target logit via a masked reduce, not take_along_axis: a gather
+        # whose indexed dim is tensor-sharded would force SPMD involuntary
+        # rematerialization (same reason as models.gpt.embed_tokens)
+        vocab_ids = jnp.arange(z.shape[-1])[None, None, :]
+        z_y = jnp.sum(
+            jnp.where(vocab_ids == y_i[..., None], z, 0.0), axis=-1
+        )
+        return acc + jnp.sum(lse - z_y), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h_c, y_c))
+    return total / (b * t)

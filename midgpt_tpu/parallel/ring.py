@@ -46,10 +46,10 @@ def _chunk_attention(
     v: Array,  # [B, Hkv, Tk, C]
     mode: Array,  # [] int32: 0 = skip, 1 = causal (diagonal), 2 = full
 ) -> tp.Tuple[Array, Array]:
-    """Attention of one (q-chunk, kv-chunk) pair -> (out[B,H,Tq,C] f32
-    UNNORMALIZED, lse[B,H,Tq] f32). Reference-parity math: scores from
-    compute-dtype inputs, f32 softmax with 1/sqrt(C) folded in
-    (model.py:71-79)."""
+    """Attention of one (q-chunk, kv-chunk) pair -> (NORMALIZED chunk
+    softmax out [B,H,Tq,C] f32, lse [B,H,Tq] f32) — the contract _merge
+    consumes. Reference-parity math: scores from compute-dtype inputs, f32
+    softmax with 1/sqrt(C) folded in (model.py:71-79)."""
     b, h, tq, c = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     groups = h // hkv

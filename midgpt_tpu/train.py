@@ -92,12 +92,36 @@ def loss_fn(
     y: Array,  # [B, T] int32
     key: tp.Optional[Array],
     deterministic: bool,
+    loss_chunk: tp.Optional[int] = None,
 ) -> Array:
-    """Batched xent; logits cast to f32 before softmax (parity:
-    train.py:72-77)."""
+    """Batched xent; logits in f32 (parity: train.py:72-77). With
+    ``loss_chunk``, the head projection + xent run T-chunk by T-chunk
+    (ops/loss.py) so the [B,T,V] f32 logits never materialize — same math,
+    ~T/chunk less peak loss memory."""
+    if loss_chunk is not None:
+        from midgpt_tpu.ops.loss import chunked_softmax_xent
+
+        h = model.hidden(x, key=key, deterministic=deterministic)
+        return chunked_softmax_xent(
+            h, model.head_weight(h.dtype), y, chunk_t=loss_chunk
+        )
     logits = model(x, key=key, deterministic=deterministic)
     logits = logits.astype(jnp.float32)
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _effective_loss_chunk(cfg: ExperimentConfig, mesh) -> tp.Optional[int]:
+    """cfg.loss_chunk, disabled when it can't apply: a sharded sequence
+    axis (the chunk scan would slice a sharded dim every step) or a T not
+    divisible by the chunk."""
+    chunk = cfg.loss_chunk
+    if chunk is None:
+        return None
+    if mesh is not None and dict(mesh.shape).get("sequence", 1) > 1:
+        return None
+    if cfg.model.block_size % chunk != 0:
+        return None
+    return chunk
 
 
 def make_train_step(
@@ -110,6 +134,7 @@ def make_train_step(
     compute_dtype = _dtype(cfg.compute_dtype)
     param_dtype = _dtype(cfg.param_dtype)
     has_dropout = cfg.model.dropout > 0.0
+    loss_chunk = _effective_loss_chunk(cfg, mesh)
 
     def step_fn(state: TrainState, x: Array, y: Array, key: Array):
         # x, y: [G, B, T]
@@ -124,6 +149,7 @@ def make_train_step(
                 params_c, x_mb, y_mb,
                 k if has_dropout else None,
                 not has_dropout,
+                loss_chunk,
             )
             # keep accumulated grads sharded like params (train.py:87)
             grads = constrain_params(grads, mesh, param_rules)
@@ -157,11 +183,12 @@ def make_train_step(
 def make_eval_step(cfg: ExperimentConfig, mesh):
     """Non-donating eval loss (parity: train.py:99-103)."""
     compute_dtype = _dtype(cfg.compute_dtype)
+    loss_chunk = _effective_loss_chunk(cfg, mesh)
 
     def eval_fn(params: GPT, x: Array, y: Array) -> Array:
         with axis_rules(mesh):
             params_c = cast_floating(params, compute_dtype)
-            return loss_fn(params_c, x, y, None, True)
+            return loss_fn(params_c, x, y, None, True, loss_chunk)
 
     return jax.jit(eval_fn)
 

@@ -1,8 +1,6 @@
 """Flash-attention kernel vs the naive oracle, on CPU via the Pallas
 interpreter. Real-TPU parity is exercised by bench.py / tpu smoke runs."""
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -10,9 +8,8 @@ import pytest
 
 from midgpt_tpu.ops.attention import naive_attention
 
-# interpret-mode pallas on CPU
+# interpret-mode pallas on CPU (shared pallas_interpret fixture)
 import midgpt_tpu.ops.flash as flash_mod
-from jax.experimental import pallas as pl
 
 
 @pytest.fixture(autouse=True)

@@ -1,12 +1,9 @@
 """Fused RMSNorm Pallas kernel vs the jnp oracle (CPU interpret mode)."""
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental import pallas as pl
 
 import midgpt_tpu.ops.fused_norm as fn
 from midgpt_tpu.models.layers import RMSNorm
