@@ -82,7 +82,8 @@ class Attention:
         impl: str = "naive",
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
-    ) -> Array:
+        return_kv: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, tp.Tuple[Array, Array]]]:
         b, t, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
@@ -132,7 +133,12 @@ class Attention:
             out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
             out = self.wo(out)
             out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
-            return shard_act(out, "batch", "seq", "embed")
+            out = shard_act(out, "batch", "seq", "embed")
+            if return_kv:
+                # post-norm, post-rope K and raw V [B, Hkv, T, C] — exactly
+                # what the KV cache stores (decode() writes the same)
+                return out, (k, v)
+            return out
 
 
     def decode(
@@ -264,16 +270,21 @@ class Block:
         impl: str = "naive",
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
-    ) -> Array:
+        return_kv: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, tp.Tuple[Array, Array]]]:
         attn_key, mlp_key = (
             jax.random.split(key) if key is not None else (None, None)
         )
-        x = x + self.attn(
+        attn_out = self.attn(
             self.ln1(x), sin, cos, impl=impl, key=attn_key,
-            deterministic=deterministic,
+            deterministic=deterministic, return_kv=return_kv,
         )
+        kv = None
+        if return_kv:
+            attn_out, kv = attn_out
+        x = x + attn_out
         x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
-        return x
+        return (x, kv) if return_kv else x
 
     def decode(self, x, cache_k, cache_v, pos, sin_t, cos_t):
         attn_out, cache_k, cache_v = self.attn.decode(
@@ -346,7 +357,11 @@ class GPT:
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
         attn_impl: tp.Optional[str] = None,
-    ) -> Array:  # [B, T, D] final (ln_f-normalized) hidden states
+        return_kv: bool = False,
+    ) -> tp.Union[Array, tp.Tuple[Array, tp.Tuple[Array, Array]]]:
+        """[B, T, D] final (ln_f-normalized) hidden states; with
+        ``return_kv`` also the per-layer post-rope K / raw V stacked
+        [L, B, Hkv, T, C] (collected as scan ys — the prefill path)."""
         cfg = self.config
         impl = attn_impl if attn_impl is not None else cfg.attn_impl
         b, t = tokens.shape
@@ -367,8 +382,10 @@ class GPT:
                 block, k = layer
                 out = block(
                     carry, sin, cos, impl=impl, key=k,
-                    deterministic=deterministic,
+                    deterministic=deterministic, return_kv=return_kv,
                 )
+                if return_kv:
+                    return out  # (x, (k, v)) — kv stacked by scan as ys
                 return out, None
 
             if cfg.remat == "full":
@@ -382,10 +399,11 @@ class GPT:
             elif cfg.remat != "none":
                 raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
-            h, _ = jax.lax.scan(
+            h, kvs = jax.lax.scan(
                 body, h, (self.blocks, scan_keys), unroll=cfg.scan_unroll
             )
-            return self.ln_f(h)
+            h = self.ln_f(h)
+            return (h, kvs) if return_kv else h
 
     def head_weight(self, dtype) -> Array:
         """[D, V] lm-head weight in ``dtype`` (the shared wte array when
@@ -460,9 +478,39 @@ def decode_step(
 def prefill(
     model: GPT, tokens: Array, cache: KVCache
 ) -> tp.Tuple[Array, KVCache]:
-    """Fill the cache with a prompt by stepping decode_step over its tokens
-    (simple and correct; a blockwise prefill kernel is a later perf item).
-    Returns logits after the last prompt token + the filled cache."""
+    """Fill the cache with a whole prompt in ONE batched forward pass —
+    the per-layer post-rope K / raw V come out of the same scan that runs
+    the blocks (return_kv), stacked along the layer axis by lax.scan.
+    O(1) passes vs the reference's O(P x full-forward) loop
+    (sample.py:72-94). Returns logits after the last prompt token + the
+    filled cache."""
+    cfg = model.config
+    b, p = tokens.shape
+    t_max = cache.k.shape[3]
+    assert p <= t_max, f"prompt {p} exceeds cache length {t_max}"
+    # ring needs a live mesh, and an explicit 'flash' may not divide an
+    # arbitrary prompt length — 'auto' keeps the flash fast path for
+    # aligned prompts and falls back to naive otherwise
+    impl = "auto" if cfg.attn_impl in ("ring", "flash") else cfg.attn_impl
+
+    h, (ks, vs) = model.hidden(
+        tokens, deterministic=True, attn_impl=impl, return_kv=True
+    )  # ks/vs: [L, B, Hkv, P, C]
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache.k, ks.astype(cache.k.dtype), 0, axis=3
+    )
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache.v, vs.astype(cache.v.dtype), 0, axis=3
+    )
+    logits = (h[:, -1, :] @ model.head_weight(h.dtype))  # [B, V]
+    return logits, KVCache(k=cache_k, v=cache_v)
+
+
+def prefill_stepwise(
+    model: GPT, tokens: Array, cache: KVCache
+) -> tp.Tuple[Array, KVCache]:
+    """Token-by-token prefill via decode_step — the oracle the batched
+    prefill is tested against."""
 
     def body(carry, tok):
         pos, cache = carry

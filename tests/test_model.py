@@ -7,6 +7,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.models.gpt import GPT, count_params
@@ -149,3 +150,41 @@ def test_dropout_training_path():
     l3 = model(tokens)
     l4 = model(tokens)
     np.testing.assert_array_equal(np.asarray(l3), np.asarray(l4))
+
+
+@pytest.mark.parametrize("remat", ["none", "full", "dots"])
+def test_remat_policies_agree(remat):
+    """All remat policies are pure memory/compute tradeoffs — identical
+    forwards and gradients."""
+    cfg_r = dataclasses.replace(CFG, remat=remat)
+    model = GPT.init(jax.random.PRNGKey(0), dataclasses.replace(CFG, remat="none"))
+    model_r = dataclasses.replace(model, config=cfg_r)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, CFG.vocab_size)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, CFG.vocab_size)
+
+    def loss(m):
+        import optax
+
+        logits = m(x).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    ref = jax.jit(loss)(model)
+    out = jax.jit(loss)(model_r)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+    g_ref = jax.jit(jax.grad(loss))(model)
+    g_out = jax.jit(jax.grad(loss))(model_r)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_out)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_scan_unroll_agrees():
+    cfg_u = dataclasses.replace(CFG, scan_unroll=2, n_layer=4)
+    cfg_1 = dataclasses.replace(CFG, scan_unroll=1, n_layer=4)
+    model = GPT.init(jax.random.PRNGKey(0), cfg_1)
+    model_u = dataclasses.replace(model, config=cfg_u)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, CFG.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(lambda m: m(x))(model_u)),
+        np.asarray(jax.jit(lambda m: m(x))(model)),
+        atol=1e-6,
+    )
