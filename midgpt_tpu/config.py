@@ -41,6 +41,8 @@ class ModelConfig:
     # False = reference semantics: shared init, independent params
     # (model.py:134-138, SURVEY.md 2.3)
     attn_impl: str = "auto"  # auto | naive | flash | ring
+    ring_schedule: str = "zigzag"  # zigzag (balanced) | standard; zigzag
+    # auto-falls back to standard when T doesn't divide 2*sequence
     norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)
     remat: str = "full"  # full | dots | none  (model.py:149 uses full)
     scan_unroll: int = 1  # lax.scan unroll over layers (model.py:154-155)

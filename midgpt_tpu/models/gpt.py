@@ -56,6 +56,7 @@ class Attention:
     n_head: int = static()
     n_kv_head: int = static()
     dropout_rate: float = static(default=0.0)
+    ring_schedule: str = static(default="zigzag")
 
     @staticmethod
     def init(key: KeyArray, cfg: ModelConfig) -> "Attention":
@@ -71,6 +72,7 @@ class Attention:
             n_head=cfg.n_head,
             n_kv_head=hkv,
             dropout_rate=cfg.dropout,
+            ring_schedule=cfg.ring_schedule,
         )
 
     def __call__(
@@ -118,7 +120,10 @@ class Attention:
                 assert self.dropout_rate == 0.0 or deterministic, (
                     "ring attention does not support attention dropout"
                 )
-                out = ring_attention(q, k, v, mesh)
+                schedule = self.ring_schedule
+                if schedule == "zigzag" and t % (2 * mesh.shape["sequence"]):
+                    schedule = "standard"  # zigzag needs T | 2S
+                out = ring_attention(q, k, v, mesh, schedule=schedule)
             else:
                 out = attention(
                     q,

@@ -141,6 +141,96 @@ def _ring_body(q, k, v, axis_name: str, use_flash: bool):
     return out.astype(q.dtype)  # partials merge pre-normalized
 
 
+def _zigzag_pair(q, k, v, causal: bool, use_flash: bool):
+    """One sub-chunk pair with a STATIC causal flag (zigzag hops only ever
+    need full or diagonal-causal visibility; skips are gated by -inf lse)."""
+    if use_flash:
+        return _chunk_flash(q, k, v, causal=causal)
+    return _chunk_attention(
+        q, k, v, jnp.asarray(1 if causal else 2, jnp.int32)
+    )
+
+
+def _zigzag_ring_body(q, k, v, axis_name: str, use_flash: bool):
+    """Zigzag-scheduled causal ring: the local T axis holds the chunk pair
+    (g1=i, g2=2S-1-i) back to back. Per hop against source device j's pair:
+
+      (q_g1, kv_g1-of-j): diagonal-causal at j==i, full at j<i, skip j>i
+      (q_g1, kv_g2-of-j): never visible (g2 chunks are all later)
+      (q_g2, kv_g1-of-j): always fully visible
+      (q_g2, kv_g2-of-j): diagonal-causal at j==i, full at j>i, skip j<i
+
+    => every hop costs exactly two half-chunk pairs on every device (three
+    on the diagonal hop), vs the standard schedule where device S-1 does
+    S times the work of device 0."""
+    s = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    tc = q.shape[2] // 2
+    qa, qb = q[:, :, :tc], q[:, :, tc:]
+
+    def halves(x):
+        return x[:, :, :tc], x[:, :, tc:]
+
+    ka, kb = halves(k)
+    va, vb = halves(v)
+
+    # diagonal hop (j == i)
+    oa, la = _zigzag_pair(qa, ka, va, True, use_flash)
+    ob, lb = _zigzag_pair(qb, ka, va, False, use_flash)
+    ob2, lb2 = _zigzag_pair(qb, kb, vb, True, use_flash)
+    ob, lb = _merge(ob, lb, ob2, lb2)
+
+    def hop(r, carry):
+        oa, la, ob, lb, k, v = carry
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        j = (idx - r) % s  # source device whose pair we now hold (j != idx)
+        ka, kb = halves(k)
+        va, vb = halves(v)
+        # (qb, kv_g1): always visible
+        o2, l2 = _zigzag_pair(qb, ka, va, False, use_flash)
+        ob, lb = _merge(ob, lb, o2, l2)
+        # the other visible pair is (qa, kv_g1) when j < i, (qb, kv_g2)
+        # when j > i — same shapes, so SELECT the operands and compute ONE
+        # pair (exactly two half-pairs per hop, as the schedule promises)
+        early = j < idx
+        q_sel = jnp.where(early, qa, qb)
+        k_sel = jnp.where(early, ka, kb)
+        v_sel = jnp.where(early, va, vb)
+        o_x, l_x = _zigzag_pair(q_sel, k_sel, v_sel, False, use_flash)
+        oa, la = _merge(
+            oa, la,
+            jnp.where(early, o_x, 0.0),
+            jnp.where(early, l_x, -jnp.inf),
+        )
+        ob, lb = _merge(
+            ob, lb,
+            jnp.where(early, 0.0, o_x),
+            jnp.where(early, -jnp.inf, l_x),
+        )
+        return oa, la, ob, lb, k, v
+
+    oa, la, ob, lb, _, _ = jax.lax.fori_loop(
+        1, s, hop, (oa, la, ob, lb, k, v)
+    )
+    return jnp.concatenate([oa, ob], axis=2).astype(q.dtype)
+
+
+def _zigzag_order(t: int, s: int):
+    """Gather indices re-laying a contiguous T axis into zigzag chunk
+    order [0, 2S-1, 1, 2S-2, ...] (device i holds pair (i, 2S-1-i)), and
+    the inverse permutation."""
+    import numpy as np
+
+    tc = t // (2 * s)
+    order = []
+    for i in range(s):
+        order += [i, 2 * s - 1 - i]
+    idx = np.concatenate([np.arange(c * tc, (c + 1) * tc) for c in order])
+    return idx, np.argsort(idx)
+
+
 def ring_attention(
     q: Array,  # [B, H, T, C] global, T sharded over 'sequence'
     k: Array,  # [B, Hkv, T, C]
@@ -151,6 +241,7 @@ def ring_attention(
     batch_axes: tp.Tuple[str, ...] = ("replica", "fsdp"),
     head_axis: tp.Optional[str] = "tensor",
     use_flash: tp.Optional[bool] = None,
+    schedule: str = "standard",
 ) -> Array:
     """Causal ring attention over the mesh. Differentiable (autodiff
     transposes the ppermute ring). T must divide by the axis size.
@@ -158,15 +249,27 @@ def ring_attention(
     use_flash: run each hop through the Pallas flash kernel (O(chunk)
     memory per hop — the true long-context path) instead of the naive
     chunk-pair math. None = auto: flash on TPU when the local chunk is
-    lane-aligned."""
+    lane-aligned.
+
+    schedule: "standard" (device i = chunk i; devices with later chunks do
+    up to S times the work of device 0) or "zigzag" (device i = chunk pair
+    (i, 2S-1-i); every hop is constant work — ~2x faster at large S). The
+    zigzag relayout is one static T-permutation before/after the ring
+    (GSPMD lowers it to an all-to-all); feeding data in zigzag order
+    upstream would remove even that."""
     s = mesh.shape[axis_name]
     t = q.shape[2]
     assert t % s == 0, f"T={t} not divisible by sequence axis {s}"
+    if schedule == "zigzag":
+        assert t % (2 * s) == 0, (
+            f"zigzag needs T={t} divisible by 2*sequence ({2 * s})"
+        )
     if use_flash is None:
         from midgpt_tpu.ops.flash import DEFAULT_BLOCK_Q
         from midgpt_tpu.utils.platform import is_tpu_backend
 
-        use_flash = is_tpu_backend() and (t // s) % DEFAULT_BLOCK_Q == 0
+        chunk = t // s if schedule == "standard" else t // (2 * s)
+        use_flash = is_tpu_backend() and chunk % DEFAULT_BLOCK_Q == 0
 
     # only shard batch/head dims over axes that actually divide them
     def fit(dim: int, axes: tp.Sequence[str]) -> tp.Tuple[str, ...]:
@@ -181,6 +284,22 @@ def ring_attention(
     b_axes = fit(q.shape[0], batch_axes)
     h_axes = fit(k.shape[1], (head_axis,) if head_axis else ())
     spec = P(b_axes if b_axes else None, h_axes if h_axes else None, axis_name, None)
+
+    if schedule == "zigzag":
+        idx, inv = _zigzag_order(t, s)
+        qz, kz, vz = (jnp.take(x, idx, axis=2) for x in (q, k, v))
+        fn = jax.shard_map(
+            functools.partial(
+                _zigzag_ring_body, axis_name=axis_name, use_flash=use_flash
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jnp.take(fn(qz, kz, vz), inv, axis=2)
+
+    assert schedule == "standard", f"unknown ring schedule {schedule!r}"
     fn = jax.shard_map(
         functools.partial(
             _ring_body, axis_name=axis_name, use_flash=use_flash

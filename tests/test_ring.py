@@ -125,3 +125,61 @@ def test_ring_flash_gqa(mesh8, pallas_interpret):
     )(q, k, v)
     ref = naive_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_ring_matches_full(mesh8):
+    """Zigzag schedule (device i holds chunk pair (i, 2S-1-i); constant
+    work per hop) must still be exact causal attention."""
+    q, k, v = _qkv(jax.random.PRNGKey(7), 2, 2, 2, 64, 16)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh8, schedule="zigzag")
+    )(q, k, v)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_ring_grads_match(mesh8):
+    q, k, v = _qkv(jax.random.PRNGKey(8), 1, 2, 2, 64, 16)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_attention(q, k, v, mesh8, schedule="zigzag") ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gn = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gn, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_zigzag_ring_flash(mesh8, pallas_interpret):
+    """Zigzag with flash hops: half-chunks of 128 through the Pallas
+    kernel."""
+    q, k, v = _qkv(jax.random.PRNGKey(9), 1, 4, 2, 512, 32)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh8, schedule="zigzag", use_flash=True
+        )
+    )(q, k, v)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_rejects_odd_chunking(mesh8):
+    q, k, v = _qkv(jax.random.PRNGKey(10), 1, 2, 2, 34, 16)
+    with pytest.raises(AssertionError):
+        ring_attention(q, k, v, mesh8, schedule="zigzag")
+
+
+def test_zigzag_ring_gqa_naive(mesh8):
+    q, k, v = _qkv(jax.random.PRNGKey(11), 1, 4, 2, 64, 16)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh8, schedule="zigzag")
+    )(q, k, v)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
