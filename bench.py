@@ -35,12 +35,19 @@ def _run_config(remat: str, batch: int):
         cfg,
         batch_size=batch,
         g_accum_iters=1,
-        model=dataclasses.replace(cfg.model, attn_impl="auto", remat=remat),
+        # scan_unroll = n_layer: profiling showed the rolled lax.scan costs
+        # ~40% of the step in dynamic-update-slice stacking + XLA's
+        # memory-pressure remat/compression copies of the carried
+        # activations; fully unrolling removed 58 ms/step of 'data
+        # formatting' + most loop-fusion overhead (15.2% -> ~40% MFU)
+        model=dataclasses.replace(
+            cfg.model, attn_impl="auto", remat=remat, scan_unroll=cfg.model.n_layer
+        ),
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
         # head+xent computed T-chunk-wise: the [B,T,V] f32 logits (3.3 GB
         # at this config) never materialize, which is what makes the
         # remat='none' rung fit in HBM
-        loss_chunk=128,
+        loss_chunk=256,
     )
 
     mesh = create_mesh(cfg.mesh)
@@ -83,19 +90,25 @@ def main() -> None:
         pass
 
     n_dev = jax.device_count()
-    # candidate ladder, fastest-expected first: no-remat trades HBM for a
-    # whole recomputed forward; fall back to whole-block remat if the
-    # compiler/allocator rejects it on this chip
+    # candidate ladder, fastest-measured first (see PERF.md r2 sweep:
+    # B=24 remat=none 40.1%, B=16 none 39.9%, dots/full B=32 ~33%); fall
+    # back if the compiler/allocator rejects a rung on this chip
     last_err = None
-    for remat, batch in (("none", 16 * n_dev), ("full", 16 * n_dev)):
+    for remat, batch in (
+        ("none", 24 * n_dev),
+        ("none", 16 * n_dev),
+        ("full", 16 * n_dev),
+    ):
         try:
             cfg, state, chain = _run_config(remat, batch)
             _, state = chain(state, 1)  # compile + 1 step
             break
         except Exception as exc:  # noqa: BLE001 — any compile/OOM falls through
+            # keep the message but drop the traceback: its frames pin the
+            # failed rung's device arrays (params + Adam moments) in HBM,
+            # which would shrink the next rung's headroom
+            exc.__traceback__ = None
             last_err = exc
-            # release the failed rung's device state before the next rung
-            # allocates its own full params + Adam moments
             cfg = state = chain = None
     else:
         raise RuntimeError(f"no bench config ran: {last_err}")

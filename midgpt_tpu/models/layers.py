@@ -19,6 +19,7 @@ Numerics preserved exactly (SURVEY.md 2.3):
 
 from __future__ import annotations
 
+import functools
 import math
 import typing as tp
 
@@ -152,11 +153,31 @@ def rope_tables(
 
 
 def rotate_every_two(x: Array) -> Array:
-    """[a b c d] -> [-b a -d c] (parity: layers.py:85-89)."""
+    """[a b c d] -> [-b a -d c] (parity: layers.py:85-89).
+
+    Reference form (kept as the oracle for tests); apply_rotary uses the
+    matmul form below on the hot path."""
     x1 = x[..., ::2]
     x2 = x[..., 1::2]
     y = jnp.stack((-x2, x1), axis=-1)
     return jnp.reshape(y, x.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _rotation_matrix(c: int, dtype_name: str) -> np.ndarray:
+    """[C, C] constant R with (x @ R) == rotate_every_two(x).
+
+    Strided even/odd slicing on the minor (lane) dim lowers to a gather on
+    TPU — and its transpose (the VJP) to a scatter-add, which profiling
+    showed as a top copy cost in the train step. As a signed permutation
+    matrix the op runs on the MXU instead, and its VJP is x @ R.T (another
+    matmul). Each output element receives exactly one +-x term, so the
+    result is bit-identical to the slicing form in any dtype."""
+    r = np.zeros((c, c), dtype=np.float32)
+    idx = np.arange(0, c, 2)
+    r[idx + 1, idx] = -1.0  # y[2i] = -x[2i+1]
+    r[idx, idx + 1] = 1.0  # y[2i+1] = x[2i]
+    return r.astype(dtype_name)
 
 
 def _duplicate_interleaved(t: Array) -> Array:
@@ -175,7 +196,8 @@ def apply_rotary(
         cos = jnp.asarray(cos, dtype=x.dtype)
         sin_full = _duplicate_interleaved(sin)
         cos_full = _duplicate_interleaved(cos)
-        return x * cos_full + rotate_every_two(x) * sin_full
+        rot = jnp.asarray(_rotation_matrix(x.shape[-1], x.dtype.name))
+        return x * cos_full + (x @ rot) * sin_full
 
 
 # ---------------------------------------------------------------------------

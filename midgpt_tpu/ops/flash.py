@@ -28,14 +28,28 @@ from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30  # avoids NaN from (-inf) - (-inf) in fully-masked rows
 
 
-def _block_sizes(t: int, bq: int, bk: int, causal: bool) -> tp.Tuple[int, int]:
-    bq = min(bq, t)
-    bk = min(bk, t)
+def _auto_block(t: int) -> int:
+    """Largest power-of-two block <= 1024 that divides T.
+
+    Measured on a v5e-class chip (B=16, H=12, T=1024, C=64, bench_kernels.py):
+    fwd 12.3ms @ 128 -> 3.2ms @ 1024; fwd+bwd 19.5ms @ 128 -> 10.2ms @ 1024.
+    The dominant cost is per-grid-step matmul issue overhead at tiny blocks,
+    so bigger is strictly better until the VMEM working set (~12 MB at 1024
+    for the dkv kernel) nears the 16 MB scoped limit."""
+    b = 1024
+    while b > 8 and t % b:
+        b //= 2
+    return min(b, t)
+
+
+def _block_sizes(
+    t: int, bq: tp.Optional[int], bk: tp.Optional[int], causal: bool
+) -> tp.Tuple[int, int]:
+    bq = _auto_block(t) if bq is None else min(bq, t)
+    bk = _auto_block(t) if bk is None else min(bk, t)
     assert t % bq == 0 and t % bk == 0, (
         f"seq len {t} must be a multiple of block sizes ({bq}, {bk})"
     )
@@ -367,8 +381,8 @@ def flash_attention(
     k: Array,
     v: Array,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: tp.Optional[int] = None,
+    block_k: tp.Optional[int] = None,
 ) -> Array:
     """Flash attention output only — delegates to flash_attention_lse (the
     dropped lse's cotangent instantiates to zeros, making the backward's
@@ -384,8 +398,8 @@ def flash_attention_lse(
     k: Array,
     v: Array,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: tp.Optional[int] = None,
+    block_k: tp.Optional[int] = None,
 ) -> tp.Tuple[Array, Array]:
     """Flash attention returning (out [B,H,T,C], lse [B,H,T]).
 

@@ -46,18 +46,29 @@ def chunked_softmax_xent(
     h_c = jnp.moveaxis(h.reshape(b, nc, chunk_t, d), 1, 0)
     y_c = jnp.moveaxis(targets.reshape(b, nc, chunk_t), 1, 0)
 
+    from midgpt_tpu.parallel.sharding import current_mesh
+
+    mesh = current_mesh()
+    vocab_sharded = mesh is not None and dict(mesh.shape).get("tensor", 1) > 1
+
     @jax.checkpoint
     def body(acc, xs):
         h_i, y_i = xs  # [B, tc, D], [B, tc]
         z = (h_i @ head_w).astype(jnp.float32)  # [B, tc, V]
         lse = jax.scipy.special.logsumexp(z, axis=-1)  # [B, tc]
-        # target logit via a masked reduce, not take_along_axis: a gather
-        # whose indexed dim is tensor-sharded would force SPMD involuntary
-        # rematerialization (same reason as models.gpt.embed_tokens)
-        vocab_ids = jnp.arange(z.shape[-1])[None, None, :]
-        z_y = jnp.sum(
-            jnp.where(vocab_ids == y_i[..., None], z, 0.0), axis=-1
-        )
+        if vocab_sharded:
+            # target logit via a masked reduce, not take_along_axis: a
+            # gather whose indexed dim is tensor-sharded would force SPMD
+            # involuntary rematerialization (same reason as
+            # models.gpt.embed_tokens)
+            vocab_ids = jnp.arange(z.shape[-1])[None, None, :]
+            z_y = jnp.sum(
+                jnp.where(vocab_ids == y_i[..., None], z, 0.0), axis=-1
+            )
+        else:
+            # unsharded vocab: a plain gather reads one element per token
+            # instead of re-reading the whole [B, tc, V] block
+            z_y = jnp.take_along_axis(z, y_i[..., None], axis=-1)[..., 0]
         return acc + jnp.sum(lse - z_y), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h_c, y_c))

@@ -265,11 +265,12 @@ def ring_attention(
             f"zigzag needs T={t} divisible by 2*sequence ({2 * s})"
         )
     if use_flash is None:
-        from midgpt_tpu.ops.flash import DEFAULT_BLOCK_Q
         from midgpt_tpu.utils.platform import is_tpu_backend
 
         chunk = t // s if schedule == "standard" else t // (2 * s)
-        use_flash = is_tpu_backend() and chunk % DEFAULT_BLOCK_Q == 0
+        # flash auto-picks a block dividing the chunk; 128 keeps a full
+        # sublane-tile-aligned block available
+        use_flash = is_tpu_backend() and chunk % 128 == 0
 
     # only shard batch/head dims over axes that actually divide them
     def fit(dim: int, axes: tp.Sequence[str]) -> tp.Tuple[str, ...]:

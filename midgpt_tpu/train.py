@@ -156,10 +156,21 @@ def make_train_step(
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
             return (grad_acc, loss_acc + loss), None
 
-        grad_init = jax.tree.map(jnp.zeros_like, params_c)
-        (grads, loss_sum), _ = jax.lax.scan(
-            microstep, (grad_init, jnp.zeros((), jnp.float32)), (x, y, keys)
-        )
+        if g == 1:
+            # no accumulation: skip the zeros-init + add passes (a full
+            # read+write of the f32 grad tree each)
+            loss_sum, grads = jax.value_and_grad(loss_fn)(
+                params_c, x[0], y[0],
+                keys[0] if has_dropout else None,
+                not has_dropout,
+                loss_chunk,
+            )
+            grads = constrain_params(grads, mesh, param_rules)
+        else:
+            grad_init = jax.tree.map(jnp.zeros_like, params_c)
+            (grads, loss_sum), _ = jax.lax.scan(
+                microstep, (grad_init, jnp.zeros((), jnp.float32)), (x, y, keys)
+            )
         loss = loss_sum / g
         # average + promote to param dtype for the f32 optimizer update
         grads = jax.tree.map(lambda gr: (gr / g).astype(param_dtype), grads)

@@ -63,6 +63,37 @@ def mfu(tokens_per_sec: float, model: ModelConfig, n_devices: int) -> float:
     return achieved / peak
 
 
+def _load_or_create_wandb_id(rundir: str, wandb_mod) -> tp.Optional[str]:
+    """Read rundir/wandb_id.txt, creating it with a fresh id on first run
+    (parity: /root/reference/launch.py:60-67). Returns None when the rundir
+    isn't a writable local path (wandb then picks its own id)."""
+    if not rundir:
+        return None
+    path = os.path.join(rundir, "wandb_id.txt")
+    try:
+        if rundir.startswith("gs://"):
+            import gcsfs
+
+            fs = gcsfs.GCSFileSystem()
+            if fs.exists(path):
+                with fs.open(path, "r") as f:
+                    return f.read().strip()
+            run_id = wandb_mod.util.generate_id()
+            with fs.open(path, "w") as f:
+                f.write(run_id)
+            return run_id
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip()
+        os.makedirs(rundir, exist_ok=True)
+        run_id = wandb_mod.util.generate_id()
+        with open(path, "w") as f:
+            f.write(run_id)
+        return run_id
+    except Exception:
+        return None
+
+
 class MetricLogger:
     """JSONL metrics + optional wandb, process-0 only (parity:
     launch.py:38-68 / train.py:212-213 wandb logging)."""
@@ -81,9 +112,14 @@ class MetricLogger:
                 import wandb
 
                 self._wandb = wandb
+                # persist the run id in the rundir so a resumed run
+                # continues the same wandb run instead of forking a new one
+                # (parity: /root/reference/launch.py:60-67)
+                run_id = _load_or_create_wandb_id(rundir, wandb)
                 wandb.init(
                     dir=rundir or None,
                     config=to_dict(config),
+                    id=run_id,
                     resume="allow",
                 )
             except Exception:
