@@ -71,16 +71,22 @@ class MeshConfig:
     fsdp: int = -1
     sequence: int = 1
     tensor: int = 1
+    # GPipe pipeline stages (midgpt_tpu.parallel.pipeline); outermost axis
+    pipeline: int = 1
 
     # number of slices for hybrid ICI/DCN meshes; 1 = single slice
     num_slices: int = 1
 
+    # microbatches streamed through the pipeline per step (GPipe bubble =
+    # (S-1)/(M+S-1)); 0 = auto (2 * pipeline stages)
+    pp_microbatches: int = 0
+
     @property
     def axis_names(self) -> tp.Tuple[str, ...]:
-        return ("replica", "fsdp", "sequence", "tensor")
+        return ("pipeline", "replica", "fsdp", "sequence", "tensor")
 
     def sizes(self, n_devices: int) -> tp.Tuple[int, ...]:
-        sizes = [self.replica, self.fsdp, self.sequence, self.tensor]
+        sizes = [self.pipeline, self.replica, self.fsdp, self.sequence, self.tensor]
         if -1 in sizes:
             known = 1
             for s in sizes:

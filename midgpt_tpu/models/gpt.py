@@ -149,21 +149,25 @@ class Attention:
     def decode(
         self,
         x: Array,  # [B, 1, D] — one new token per sequence
-        cache_k: Array,  # [B, Hkv, T_max, C]
-        cache_v: Array,  # [B, Hkv, T_max, C]
-        pos: Array,  # [] int32 — number of tokens already in the cache
-        sin_t: Array,  # [T_max, C//2] rope tables
-        cos_t: Array,
+        cache_k: Array,  # [B, Hkv, W, C] ring buffer
+        cache_v: Array,  # [B, Hkv, W, C]
+        slot: Array,  # [] int32 — ring slot to write (pos % W)
+        mask: Array,  # [W] f32 additive mask over cache slots (0 / -inf)
+        sin_row: Array,  # [1, C//2] rope row at the token's ABSOLUTE position
+        cos_row: Array,
     ) -> tp.Tuple[Array, Array, Array]:
-        """Single-token incremental attention against a KV cache.
+        """Single-token incremental attention against a ring-buffer KV cache.
 
         The reference has no decode path (sample.py:72-94 re-runs the full
-        forward per token); this is the TPU-native replacement: O(T) per
-        token, static shapes, jit/scan-friendly."""
+        forward per token); this is the TPU-native replacement: O(W) per
+        token, static shapes, jit/scan-friendly. Keys are roped at absolute
+        positions, so evicting the oldest slot implements the reference's
+        sliding window (sample.py:74 ``idx[:, -block_size:]``) exactly:
+        attention scores depend only on position DIFFERENCES (RoPE shift
+        invariance, tests/test_layers.py)."""
         b, one, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
-        t_max = cache_k.shape[2]
         qkv = self.wqkv(x)  # [B, 1, (H+2Hkv)C]
         q = qkv[..., : h * c].reshape(b, 1, h, c)
         k = qkv[..., h * c : (h + hkv) * c].reshape(b, 1, hkv, c)
@@ -174,24 +178,18 @@ class Attention:
         q = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, 1, C]
         k = jnp.transpose(k, (0, 2, 1, 3))  # [B, Hkv, 1, C]
         v = jnp.transpose(v, (0, 2, 1, 3))
-        # rope at position `pos`
-        sin_row = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, axis=0)
-        cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)
         q = apply_rotary(q, sin_row, cos_row)
         k = apply_rotary(k, sin_row, cos_row)
         cache_k = jax.lax.dynamic_update_slice_in_dim(
-            cache_k, k.astype(cache_k.dtype), pos, axis=2
+            cache_k, k.astype(cache_k.dtype), slot, axis=2
         )
         cache_v = jax.lax.dynamic_update_slice_in_dim(
-            cache_v, v.astype(cache_v.dtype), pos, axis=2
+            cache_v, v.astype(cache_v.dtype), slot, axis=2
         )
-        # attend to cache positions <= pos (static shape, masked)
         qg = q.reshape(b, hkv, h // hkv, 1, c)
         scores = jnp.einsum(
             "bkgqc,bkjc->bkgqj", qg, cache_k, preferred_element_type=jnp.float32
-        )  # [B, Hkv, G, 1, T_max]
-        idx = jnp.arange(t_max)
-        mask = jnp.where(idx <= pos, 0.0, -jnp.inf).astype(jnp.float32)
+        )  # [B, Hkv, G, 1, W]
         probs = jax.nn.softmax(
             (scores + mask) / math.sqrt(c), axis=-1
         ).astype(cache_v.dtype)
@@ -291,9 +289,9 @@ class Block:
         x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
         return (x, kv) if return_kv else x
 
-    def decode(self, x, cache_k, cache_v, pos, sin_t, cos_t):
+    def decode(self, x, cache_k, cache_v, slot, mask, sin_row, cos_row):
         attn_out, cache_k, cache_v = self.attn.decode(
-            self.ln1(x), cache_k, cache_v, pos, sin_t, cos_t
+            self.ln1(x), cache_k, cache_v, slot, mask, sin_row, cos_row
         )
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
@@ -451,16 +449,34 @@ class KVCache:
 def decode_step(
     model: GPT,
     tokens: Array,  # [B] int32 — the newest token per sequence
-    pos: Array,  # [] int32 — how many tokens are already cached
+    pos: Array,  # [] int32 — ABSOLUTE position of this token (tokens so far)
     cache: KVCache,
+    rope_len: tp.Optional[int] = None,
 ) -> tp.Tuple[Array, KVCache]:
     """One incremental decoding step: logits for the next token + updated
-    cache. O(T_max) per token vs the reference's O(T * full-forward)
-    (sample.py:72-94)."""
+    cache. O(W) per token vs the reference's O(T * full-forward)
+    (sample.py:72-94).
+
+    The cache is a ring buffer of W = cache length slots. While pos < W
+    this is ordinary append-at-pos decoding; past W it becomes the
+    reference's sliding window (sample.py:74): the new token evicts the
+    oldest. ``rope_len`` sizes the rope tables (>= total generation length;
+    defaults to W for the non-sliding case)."""
     cfg = model.config
-    t_max = cache.k.shape[3]
-    sin_np, cos_np = rope_tables(cfg.head_dim, t_max, cfg.rope_base)
+    w = cache.k.shape[3]
+    sin_np, cos_np = rope_tables(cfg.head_dim, rope_len or w, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    # ring arithmetic (all static-shape): write slot and per-slot validity.
+    # slot s holds absolute position abs_s = pos - ((pos - s) mod W); it is
+    # a real entry iff abs_s >= 0 — which also guarantees abs_s > pos - W
+    # (in-window) and abs_s <= pos (causal).
+    slot = jnp.mod(pos, w)
+    idx = jnp.arange(w)
+    abs_pos = pos - jnp.mod(pos - idx, w)
+    mask = jnp.where(abs_pos >= 0, 0.0, -jnp.inf).astype(jnp.float32)
+    sin_row = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, axis=0)
+    cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)
 
     h = embed_tokens(model.wte, tokens[:, None])  # [B, 1, D]
 
@@ -468,7 +484,8 @@ def decode_step(
         x = carry
         block, ck, cv = layer
         x, ck, cv = block.decode(
-            x, ck, cv, pos, sin_t.astype(x.dtype), cos_t.astype(x.dtype)
+            x, ck, cv, slot, mask,
+            sin_row.astype(x.dtype), cos_row.astype(x.dtype),
         )
         return x, (ck, cv)
 
@@ -562,3 +579,28 @@ GPT_PARAM_RULES: tp.Sequence[tp.Tuple[str, P]] = (
     # [D, V]: embed over fsdp, vocab over tensor
     (r"lm_head/weight", P("fsdp", "tensor")),
 )
+
+# Pipeline-parallel variant: block leaves additionally shard their leading
+# (stacked-layer) axis over 'pipeline' — L/S layers per stage, which is what
+# parallel.pipeline's shard_map strips. Non-stacked params (wte, ln_f,
+# lm_head) stay pipeline-replicated: embedding/head run outside the pipeline
+# (parallel.pipeline.gpt_pipeline_hidden). Specs here are full-rank (the
+# right-alignment padding in param_shardings would otherwise misplace the
+# leading 'pipeline' entry).
+GPT_PP_PARAM_RULES: tp.Sequence[tp.Tuple[str, P]] = (
+    (r"wte/weight", P("tensor", "fsdp")),
+    (r"attn/wqkv/weight", P("pipeline", "fsdp", "tensor")),
+    (r"attn/wo/weight", P("pipeline", "tensor", "fsdp")),
+    (r"attn/(q|k)_norm/weight", P("pipeline", None)),
+    (r"mlp/w_(up|gate)/weight", P("pipeline", "fsdp", "tensor")),
+    (r"mlp/w_down/weight", P("pipeline", "tensor", "fsdp")),
+    (r"ln_f/weight", P()),
+    (r"ln1/weight|ln2/weight", P("pipeline", None)),
+    (r"lm_head/weight", P("fsdp", "tensor")),
+)
+
+
+def gpt_param_rules(pipeline: bool = False) -> tp.Sequence[tp.Tuple[str, P]]:
+    """Partition-rule table for a GPT; ``pipeline=True`` adds the
+    stacked-layer-axis sharding the PP trainer needs."""
+    return GPT_PP_PARAM_RULES if pipeline else GPT_PARAM_RULES

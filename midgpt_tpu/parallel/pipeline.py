@@ -50,6 +50,7 @@ def pipeline_forward(
     *,
     axis: str = "pipeline",
     remat: bool = True,
+    check_vma: bool = True,
 ) -> Array:
     """Run ``x`` through all L layers, pipelined over the ``axis`` stages.
 
@@ -70,7 +71,13 @@ def pipeline_forward(
 
     def per_stage(params_local, x_local):
         # params_local leaves: [L/S, ...] (shard_map strips the stage dim)
-        # x_local: [M, Bm, ...] (replicated across the pipeline axis)
+        # x_local: [M, Bm, ...] (replicated across the pipeline axis).
+        # Everything entering the tick carry is promoted to pipeline-VARYING
+        # (pvary): the carry mixes per-stage values (ppermute output, banked
+        # activations) with broadcast inputs, and an invariant/varying mix in
+        # a scan carry is unsound — it surfaced as an XLA miscompile
+        # ("Invalid binary instruction opcode copy") under check_vma=False.
+        x_local = jax.lax.pvary(x_local, axis)
         s_idx = jax.lax.axis_index(axis)
         n_ticks = m + n_stages - 1
         zero_act = jnp.zeros_like(x_local[0])
@@ -107,7 +114,9 @@ def pipeline_forward(
             )
             return (sent, outputs), None
 
-        outputs0 = jnp.zeros((m,) + x_local.shape[1:], x_local.dtype)
+        outputs0 = jax.lax.pvary(
+            jnp.zeros((m,) + x_local.shape[1:], x_local.dtype), axis
+        )
         (_, outputs), _ = jax.lax.scan(
             tick, (zero_act, outputs0), jnp.arange(n_ticks)
         )
@@ -122,12 +131,16 @@ def pipeline_forward(
         jax.tree.map(lambda _: P(axis), stacked_params),  # stage dim = leading
         P(),  # input replicated over the pipeline axis
     )
+    # partial-auto: only the pipeline axis is manual; any other mesh axes
+    # (replica/fsdp/sequence/tensor) stay under GSPMD, so PP composes with
+    # the data/tensor shardings of the surrounding train step
     return jax.shard_map(
         per_stage,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=P(),
-        check_vma=False,
+        axis_names={axis},
+        check_vma=check_vma,
     )(stacked_params, x)
 
 
@@ -144,3 +157,71 @@ def stage_scan_fn(block_fn: tp.Callable[[tp.Any, Array], Array]) -> StageFn:
         return out
 
     return stage
+
+
+def gpt_pipeline_hidden(
+    model,  # midgpt_tpu.models.gpt.GPT
+    tokens: Array,  # [B, T] int32
+    mesh: Mesh,
+    *,
+    n_micro: int = 0,
+    axis: str = "pipeline",
+) -> Array:
+    """GPT forward with the block stack pipelined over ``axis``.
+
+    The integration split (SURVEY.md 2.6 PP row): embedding runs BEFORE the
+    pipeline and ln_f/lm-head AFTER it, as ordinary GSPMD ops over the full
+    mesh — the natural TPU placement of the reference's stage-0-embedding /
+    stage-(S-1)-head convention, since wte/ln_f/head params are not
+    layer-stacked and GSPMD already shards them (fsdp/tensor). Only the
+    ``blocks`` stack (leaves ``[L, ...]``, L/S layers per stage) enters
+    the shard_map, which is manual ONLY over the pipeline axis — data /
+    tensor sharding of the activations stays with GSPMD (partial-auto).
+
+    Deterministic-only: GPipe microbatch scheduling does not thread
+    per-layer dropout keys (all OWT-family configs run dropout 0).
+    Returns ln_f-normalized hidden states [B, T, D]."""
+    from midgpt_tpu.models.gpt import embed_tokens
+    from midgpt_tpu.models.layers import rope_tables
+    from midgpt_tpu.parallel.sharding import axis_rules, shard_act
+
+    cfg = model.config
+    assert cfg.attn_impl != "ring", (
+        "ring attention inside pipeline stages is unsupported (the sequence "
+        "axis is invisible inside the pipeline's manual region)"
+    )
+    b, t = tokens.shape
+    s = mesh.shape[axis]
+    m = n_micro or 2 * s
+    assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
+    sin, cos = rope_tables(cfg.head_dim, t, cfg.rope_base)
+    impl = cfg.attn_impl
+
+    h = embed_tokens(model.wte, tokens)  # [B, T, D]
+    h = shard_act(h, "batch", "seq", "embed")
+    compute_dtype = h.dtype
+    # activations cross the shard_map boundary (and ride the inter-stage
+    # ppermutes) in float32: a bf16 shard_map output consumed as a backward
+    # residual miscompiles XLA ("Invalid binary instruction opcode copy",
+    # reduced repro in tests/test_pipeline.py history). Stage-internal
+    # compute stays in the model's compute dtype.
+    boundary_dtype = (
+        jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    )
+    h = h.astype(boundary_dtype).reshape(m, b // m, t, cfg.n_embd)
+
+    def stage_fn(params_local, x):
+        # one cast per stage boundary, not per layer; no activation-sharding
+        # constraints inside the manual region (the pipeline axis is
+        # invisible to GSPMD there; auto axes keep the inputs' shardings)
+        with axis_rules(None):
+            def body(hh, bp):
+                return bp(hh, sin, cos, impl=impl, deterministic=True), None
+
+            y, _ = jax.lax.scan(body, x.astype(compute_dtype), params_local)
+        return y.astype(boundary_dtype)
+
+    out = pipeline_forward(model.blocks, h, stage_fn, mesh, axis=axis)
+    h = out.reshape(b, t, cfg.n_embd).astype(compute_dtype)
+    h = shard_act(h, "batch", "seq", "embed")
+    return model.ln_f(h)

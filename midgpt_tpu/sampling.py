@@ -39,32 +39,78 @@ def generate(
     temperature: float = 1.0,
     top_k: tp.Optional[int] = None,
     cache_dtype=jnp.bfloat16,
+    sliding: str = "exact",
 ) -> Array:
     """Returns [B, max_new_tokens] sampled continuations (parity:
-    sample.py:68-95 generate, temperature semantics sample.py:88-92)."""
+    sample.py:68-95 generate, temperature semantics sample.py:88-92).
+
+    Up to ``block_size`` total tokens, decoding is KV-cached (O(W)/token vs
+    the reference's full re-forward per token). Past ``block_size`` the
+    window must slide (sample.py:74 ``idx[:, -block_size:]``) and two
+    semantics are offered:
+
+    - ``sliding="exact"`` (default): re-run the cropped-window full forward
+      per token — bit-parity with the reference, which *recomputes the
+      hidden states of past tokens under the shrunken context* each step.
+      Same O(W * fwd)/token cost the reference always pays.
+    - ``sliding="kv"``: ring-buffer cache, evict-oldest. Past tokens keep
+      the hidden states they were computed with (standard sliding-window
+      KV decoding, O(W)/token). Diverges from the reference once the
+      window slides — fast mode, not a parity mode.
+    """
+    assert sliding in ("exact", "kv"), f"unknown sliding mode {sliding!r}"
     b, p = prompt.shape
     cfg = model.config
+    if p > cfg.block_size:
+        # reference conditions on the last block_size tokens (sample.py:74)
+        prompt = prompt[:, -cfg.block_size :]
+        p = cfg.block_size
     total = p + max_new_tokens
-    assert total <= cfg.block_size, (
-        f"prompt {p} + new {max_new_tokens} exceeds block_size {cfg.block_size}"
-    )
-    cache = KVCache.init(cfg, b, total, dtype=cache_dtype)
+    w = min(total, cfg.block_size)
+    cache = KVCache.init(cfg, b, w, dtype=cache_dtype)
     logits, cache = prefill(model, prompt, cache)
 
     def body(carry, _):
         logits, pos, cache, k = carry
         k, sub = jax.random.split(k)
         tok = _sample_token(logits, sub, temperature, top_k)
-        new_logits, cache = decode_step(model, tok, pos, cache)
+        new_logits, cache = decode_step(model, tok, pos, cache, rope_len=total)
         return (new_logits, pos + 1, cache, k), tok
 
-    (_, _, _, _), toks = jax.lax.scan(
-        body,
-        (logits, jnp.asarray(p, jnp.int32), cache, key),
-        None,
-        length=max_new_tokens,
+    n1 = w - p  # tokens decodable before the window would slide
+    (logits, _, cache, key), toks1 = jax.lax.scan(
+        body, (logits, jnp.asarray(p, jnp.int32), cache, key), None, length=n1
     )
-    return jnp.transpose(toks)  # [B, N]
+    toks1 = jnp.transpose(toks1)  # [B, n1]
+    if total <= w:
+        return toks1
+
+    n2 = total - w
+    if sliding == "kv":
+        # same decode body; pos continues from w, evicting the oldest slot
+        (_, _, _, _), toks2 = jax.lax.scan(
+            body, (logits, jnp.asarray(w, jnp.int32), cache, key), None,
+            length=n2,
+        )
+    else:  # exact
+        window = jnp.concatenate([prompt, toks1], axis=1)  # [B, W]
+        # single-chip full forward: ring needs a live mesh and an explicit
+        # 'flash' may not divide W — same impl fallback prefill uses
+        # (models/gpt.py prefill)
+        impl = "auto" if cfg.attn_impl in ("ring", "flash") else cfg.attn_impl
+
+        def body2(carry, _):
+            logits, window, k = carry
+            k, sub = jax.random.split(k)
+            tok = _sample_token(logits, sub, temperature, top_k)
+            window = jnp.concatenate([window[:, 1:], tok[:, None]], axis=1)
+            new_logits = model(window, attn_impl=impl)[:, -1, :]
+            return (new_logits, window, k), tok
+
+        (_, _, _), toks2 = jax.lax.scan(
+            body2, (logits, window, key), None, length=n2
+        )
+    return jnp.concatenate([toks1, jnp.transpose(toks2)], axis=1)
 
 
 def make_sampler(
@@ -74,6 +120,7 @@ def make_sampler(
     temperature: float = 1.0,
     top_k: tp.Optional[int] = None,
     cache_dtype=jnp.bfloat16,
+    sliding: str = "exact",
 ):
     """A jitted ``(model, prompt, key) -> tokens`` sampler.
 
@@ -94,6 +141,7 @@ def make_sampler(
                 temperature=temperature,
                 top_k=top_k,
                 cache_dtype=cache_dtype,
+                sliding=sliding,
             )
 
     return jax.jit(fn)

@@ -93,20 +93,34 @@ def loss_fn(
     key: tp.Optional[Array],
     deterministic: bool,
     loss_chunk: tp.Optional[int] = None,
+    pp_mesh=None,
+    pp_microbatches: int = 0,
 ) -> Array:
     """Batched xent; logits in f32 (parity: train.py:72-77). With
     ``loss_chunk``, the head projection + xent run T-chunk by T-chunk
     (ops/loss.py) so the [B,T,V] f32 logits never materialize — same math,
-    ~T/chunk less peak loss memory."""
+    ~T/chunk less peak loss memory. With ``pp_mesh``, the block stack runs
+    pipelined over the mesh's 'pipeline' axis (parallel.pipeline)."""
+    if pp_mesh is not None:
+        from midgpt_tpu.parallel.pipeline import gpt_pipeline_hidden
+
+        assert key is None and deterministic, (
+            "the pipeline-parallel path is deterministic-only (GPipe "
+            "scheduling does not thread per-layer dropout keys)"
+        )
+        h = gpt_pipeline_hidden(model, x, pp_mesh, n_micro=pp_microbatches)
+    else:
+        h = model.hidden(x, key=key, deterministic=deterministic)
     if loss_chunk is not None:
         from midgpt_tpu.ops.loss import chunked_softmax_xent
 
-        h = model.hidden(x, key=key, deterministic=deterministic)
         return chunked_softmax_xent(
             h, model.head_weight(h.dtype), y, chunk_t=loss_chunk
         )
-    logits = model(x, key=key, deterministic=deterministic)
-    logits = logits.astype(jnp.float32)
+    from midgpt_tpu.parallel.sharding import shard_act
+
+    logits = h @ model.head_weight(h.dtype)  # [B, T, V]
+    logits = shard_act(logits, "batch", "seq", "vocab").astype(jnp.float32)
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 
@@ -124,17 +138,30 @@ def _effective_loss_chunk(cfg: ExperimentConfig, mesh) -> tp.Optional[int]:
     return chunk
 
 
+def _cfg_param_rules(cfg: ExperimentConfig):
+    from midgpt_tpu.models.gpt import gpt_param_rules
+
+    return gpt_param_rules(pipeline=cfg.mesh.pipeline > 1)
+
+
 def make_train_step(
     cfg: ExperimentConfig,
     tx: optax.GradientTransformation,
     mesh,
-    param_rules=GPT_PARAM_RULES,
+    param_rules=None,
 ):
     """The jitted, donated train step (parity: train.py:79-97)."""
     compute_dtype = _dtype(cfg.compute_dtype)
     param_dtype = _dtype(cfg.param_dtype)
     has_dropout = cfg.model.dropout > 0.0
     loss_chunk = _effective_loss_chunk(cfg, mesh)
+    if param_rules is None:
+        param_rules = _cfg_param_rules(cfg)
+    pp_mesh = mesh if cfg.mesh.pipeline > 1 else None
+    if pp_mesh is not None:
+        assert not has_dropout, (
+            "pipeline parallelism is deterministic-only; set dropout=0"
+        )
 
     def step_fn(state: TrainState, x: Array, y: Array, key: Array):
         # x, y: [G, B, T]
@@ -150,6 +177,8 @@ def make_train_step(
                 k if has_dropout else None,
                 not has_dropout,
                 loss_chunk,
+                pp_mesh,
+                cfg.mesh.pp_microbatches,
             )
             # keep accumulated grads sharded like params (train.py:87)
             grads = constrain_params(grads, mesh, param_rules)
@@ -164,6 +193,8 @@ def make_train_step(
                 keys[0] if has_dropout else None,
                 not has_dropout,
                 loss_chunk,
+                pp_mesh,
+                cfg.mesh.pp_microbatches,
             )
             grads = constrain_params(grads, mesh, param_rules)
         else:
@@ -195,20 +226,26 @@ def make_eval_step(cfg: ExperimentConfig, mesh):
     """Non-donating eval loss (parity: train.py:99-103)."""
     compute_dtype = _dtype(cfg.compute_dtype)
     loss_chunk = _effective_loss_chunk(cfg, mesh)
+    pp_mesh = mesh if cfg.mesh.pipeline > 1 else None
 
     def eval_fn(params: GPT, x: Array, y: Array) -> Array:
         with axis_rules(mesh):
             params_c = cast_floating(params, compute_dtype)
-            return loss_fn(params_c, x, y, None, True, loss_chunk)
+            return loss_fn(
+                params_c, x, y, None, True, loss_chunk,
+                pp_mesh, cfg.mesh.pp_microbatches,
+            )
 
     return jax.jit(eval_fn)
 
 
 def init_state(
-    cfg: ExperimentConfig, mesh, tx, key: Array, param_rules=GPT_PARAM_RULES
+    cfg: ExperimentConfig, mesh, tx, key: Array, param_rules=None
 ) -> TrainState:
     """Init under jit with sharding constraints so params materialize
     directly sharded (parity: train.py:163-177)."""
+    if param_rules is None:
+        param_rules = _cfg_param_rules(cfg)
 
     def init_fn(k):
         model = GPT.init(k, cfg.model)

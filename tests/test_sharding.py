@@ -28,14 +28,14 @@ CFG = ModelConfig(
 
 
 def test_mesh_config_sizes():
-    assert MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=2).sizes(8) == (1, 4, 1, 2)
-    assert MeshConfig(replica=2, fsdp=2, sequence=1, tensor=2).sizes(8) == (2, 2, 1, 2)
+    assert MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=2).sizes(8) == (1, 1, 4, 1, 2)
+    assert MeshConfig(replica=2, fsdp=2, sequence=1, tensor=2).sizes(8) == (1, 2, 2, 1, 2)
     with pytest.raises(AssertionError):
         MeshConfig(replica=3, fsdp=-1).sizes(8)  # 8 % 3 != 0
 
 
 def test_create_mesh_8dev(mesh8):
-    assert mesh8.axis_names == ("replica", "fsdp", "sequence", "tensor")
+    assert mesh8.axis_names == ("pipeline", "replica", "fsdp", "sequence", "tensor")
     assert mesh8.devices.size == 8
 
 
