@@ -73,9 +73,17 @@ class RMSNorm:
     """x * rsqrt(mean(x^2, -1) + eps) [* weight] (parity: layers.py:60-75).
 
     impl: "jnp" (XLA-fused elementwise chain) | "fused" (Pallas one-pass
-    kernel, midgpt_tpu.ops.fused_norm) | "auto" (jnp — flip to fused where
-    profiling shows a win). The fused path needs D % 128 == 0 and a TPU;
-    otherwise it silently falls back to jnp.
+    kernel, midgpt_tpu.ops.fused_norm) | "auto" (= jnp, by measurement).
+    The fused path needs D % 128 == 0 and a TPU; otherwise it silently
+    falls back to jnp.
+
+    Why auto == jnp: measured on a v5e-class chip
+    (scripts/bench_kernels.py, r2): fused fwd is slightly faster
+    (6.5us vs 10.2us at [16,1024,768]) but its custom-VJP backward costs
+    236us vs jnp's 10us — XLA fuses the jnp backward into neighboring ops
+    while the Pallas backward is a separate kernel launch + extra HBM
+    round trip. Training always takes the jnp path; "fused" remains a
+    tested oracle and a forward-only/inference option.
     """
 
     weight: tp.Optional[Array]  # [D] or None

@@ -110,7 +110,12 @@ def attention(
         from midgpt_tpu.ops.flash import flash_attention
 
         assert dropout_rate == 0.0 or deterministic, (
-            "flash attention does not support attention dropout; use naive"
+            "flash attention does not implement attention dropout — a "
+            "deliberate trade (PERF.md r2): the only dropout config in the "
+            "reference family is shakespeare_char (T=256, 10M params), "
+            "where naive attention's T^2 cost is negligible; every "
+            "OWT-family config runs dropout 0 on the flash path. "
+            "impl='auto' already routes dropout configs to naive."
         )
         return flash_attention(q, k, v, causal=causal)
     if impl == "ring":

@@ -43,9 +43,12 @@ def group_by_slice(
     n = len(devices)
     assert n % num_slices == 0, f"{n} devices not divisible by {num_slices} slices"
     idx = {getattr(d, "slice_index", None) for d in devices}
-    if None not in idx:
-        # real DCN topology: the config MUST match it — silently splitting
-        # contiguously would place ICI axes across a DCN boundary
+    if None not in idx and len(idx) > 1:
+        # real multi-slice DCN topology: the config MUST match it — silently
+        # splitting contiguously would place ICI axes across a DCN boundary.
+        # (len(idx) == 1 — all devices in one physical slice — falls through
+        # to the contiguous split: that's the single-slice testbed standing
+        # in for N slices.)
         assert len(idx) == num_slices, (
             f"devices report {len(idx)} physical slices {sorted(idx)} but "
             f"num_slices={num_slices}; set MeshConfig.num_slices to the "
