@@ -66,6 +66,27 @@ def naive_attention(
         return out.reshape(b, h, t, c)
 
 
+def resolve_impl(
+    impl: str,
+    seq_len: int,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+) -> str:
+    """Resolve "auto" to a concrete implementation: flash on TPU when the
+    sequence tiles (T % 128 == 0) and no attention dropout, else naive."""
+    if impl != "auto":
+        return impl
+    from midgpt_tpu.utils.platform import is_tpu_backend
+
+    use_flash = (
+        is_tpu_backend()
+        and (dropout_rate == 0.0 or deterministic)
+        and seq_len >= 128
+        and seq_len % 128 == 0
+    )
+    return "flash" if use_flash else "naive"
+
+
 def attention(
     q: Array,
     k: Array,
@@ -85,16 +106,7 @@ def attention(
       naive - reference O(T^2) math (oracle)
       flash - Pallas blockwise online-softmax kernel
     """
-    if impl == "auto":
-        from midgpt_tpu.utils.platform import is_tpu_backend
-
-        use_flash = (
-            is_tpu_backend()
-            and (dropout_rate == 0.0 or deterministic)
-            and q.shape[2] >= 128
-            and q.shape[2] % 128 == 0
-        )
-        impl = "flash" if use_flash else "naive"
+    impl = resolve_impl(impl, q.shape[2], dropout_rate, deterministic)
 
     if impl == "naive":
         return naive_attention(

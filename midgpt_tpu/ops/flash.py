@@ -6,9 +6,14 @@ scores never materialize in HBM; softmax runs in float32 with the 1/sqrt(C)
 scale folded into the softmax argument, exactly mirroring the reference
 numerics (SURVEY.md 2.3).
 
-Layout [B, H, T, C]; K/V may carry fewer (grouped) heads — the kernel grid
-maps each Q head to its KV group, so tensor-parallel head sharding composes
-(each shard sees a smaller H).
+Layouts: ``"bhtc"`` ([B, H, T, C], the classic flash layout) or ``"bthc"``
+([B, T, H, C], the projection-natural layout) — the latter lets the model
+skip four [B,T,H,C]<->[B,H,T,C] transpose materializations per attention
+call (q/k/v in, out; doubled again in the backward), which profiling showed
+as ~8 ms/step of pure copies at the 124M bench shape. The kernel grid is
+identical; only the BlockSpec index maps change. K/V may carry fewer
+(grouped) heads — the grid maps each Q head to its KV group, so
+tensor-parallel head sharding composes (each shard sees a smaller H).
 
 Forward residual is the standard (out, logsumexp) pair; backward runs two
 kernels (dQ over Q blocks; dK/dV over KV blocks) plus a trivial elementwise
@@ -67,6 +72,42 @@ def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
     return rows >= cols
 
 
+# --- layout plumbing: "bhtc" [B,H,T,C] vs "bthc" [B,T,H,C] ----------------
+
+
+def _act_spec(layout: str, rows: int, c: int, row_fn, head_fn):
+    """BlockSpec for a q/k/v/o/do activation carrying ``rows`` sequence rows.
+
+    ``row_fn(grid indices) -> row-block index``; ``head_fn(h) -> head (or KV
+    group) index``. The kernel always sees a [rows, c] tile; only where that
+    tile sits in the global array depends on the layout."""
+    if layout == "bhtc":
+        return pl.BlockSpec(
+            (1, 1, rows, c),
+            lambda *g: (g[0], head_fn(g[1]), row_fn(*g), 0),
+        )
+    assert layout == "bthc", layout
+    return pl.BlockSpec(
+        (1, rows, 1, c),
+        lambda *g: (g[0], row_fn(*g), head_fn(g[1]), 0),
+    )
+
+
+def _read(layout: str, ref) -> Array:
+    return ref[0, 0] if layout == "bhtc" else ref[0, :, 0, :]
+
+
+def _write(layout: str, ref, value) -> None:
+    if layout == "bhtc":
+        ref[0, 0] = value
+    else:
+        ref[0, :, 0, :] = value
+
+
+def _act_shape(layout: str, b: int, h: int, t: int, c: int):
+    return (b, h, t, c) if layout == "bhtc" else (b, t, h, c)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -74,7 +115,7 @@ def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int, layout: str,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
@@ -89,9 +130,9 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]  # [bq, C]
-        k = k_ref[0, 0]  # [bk, C]
-        v = v_ref[0, 0]  # [bk, C]
+        q = _read(layout, q_ref)  # [bq, C]
+        k = _read(layout, k_ref)  # [bk, C]
+        v = _read(layout, v_ref)  # [bk, C]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
@@ -124,15 +165,25 @@ def _fwd_kernel(
         m = m_ref[:, :1]
         l = l_ref[:, :1]
         # causal rows always have >= 1 visible key, so l > 0
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        _write(layout, o_ref, (acc_ref[:] / l).astype(o_ref.dtype))
         lse_ref[0, 0] = m + jnp.log(l)
 
 
+def _dims(layout: str, x: Array) -> tp.Tuple[int, int, int, int]:
+    """(B, H, T, C) of an activation in either layout."""
+    if layout == "bhtc":
+        b, h, t, c = x.shape
+    else:
+        b, t, h, c = x.shape
+    return b, h, t, c
+
+
 def _flash_forward(
-    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int
+    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int,
+    layout: str = "bhtc",
 ) -> tp.Tuple[Array, Array]:
-    b, h, t, c = q.shape
-    hkv, s = k.shape[1], k.shape[2]
+    b, h, t, c = _dims(layout, q)
+    _, hkv, s, _ = _dims(layout, k)
     assert s == t, "self-attention only (use decode path for caches)"
     groups = h // hkv
     bq, bk = _block_sizes(t, bq, bk, causal)
@@ -140,26 +191,27 @@ def _flash_forward(
     scale = 1.0 / math.sqrt(c)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        layout=layout,
     )
+    row_q = lambda b_, h_, iq, ik: iq  # noqa: E731
+    row_k = lambda b_, h_, iq, ik: ik  # noqa: E731
+    kv_head = lambda h_: h_ // groups  # noqa: E731
+    q_head = lambda h_: h_  # noqa: E731
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
-            ),
+            _act_spec(layout, bq, c, row_q, q_head),
+            _act_spec(layout, bk, c, row_k, kv_head),
+            _act_spec(layout, bk, c, row_k, kv_head),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            _act_spec(layout, bq, c, row_q, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
+            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), q.dtype),
             jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -181,7 +233,7 @@ def _flash_forward(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int, layout: str,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
@@ -193,10 +245,10 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
+        q = _read(layout, q_ref)
+        k = _read(layout, k_ref)
+        v = _read(layout, v_ref)
+        do = _read(layout, do_ref)
         lse = lse_ref[0, 0]  # [bq, 1] f32
         delta = delta_ref[0, 0]  # [bq, 1] f32
         s = jax.lax.dot_general(
@@ -223,13 +275,13 @@ def _bwd_dq_kernel(
 
     @pl.when(ik == last_k)
     def _finalize():
-        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+        _write(layout, dq_ref, dq_acc[:].astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nq: int,
+    *, scale: float, causal: bool, bq: int, bk: int, nq: int, layout: str,
 ):
     ik, iq = pl.program_id(2), pl.program_id(3)
 
@@ -242,10 +294,10 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0]  # [bq, C]
-        k = k_ref[0, 0]  # [bk, C]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]  # [bq, C]
+        q = _read(layout, q_ref)  # [bq, C]
+        k = _read(layout, k_ref)  # [bk, C]
+        v = _read(layout, v_ref)
+        do = _read(layout, do_ref)  # [bq, C]
         lse = lse_ref[0, 0]  # [bq, 1]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -276,52 +328,58 @@ def _bwd_dkv_kernel(
 
     @pl.when(iq == nq - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        _write(layout, dk_ref, dk_acc[:].astype(dk_ref.dtype))
+        _write(layout, dv_ref, dv_acc[:].astype(dv_ref.dtype))
 
 
 def _flash_backward(
     q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
     *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None,
+    layout: str = "bhtc",
 ) -> tp.Tuple[Array, Array, Array]:
-    b, h, t, c = q.shape
-    hkv = k.shape[1]
+    b, h, t, c = _dims(layout, q)
+    hkv = _dims(layout, k)[1]
     groups = h // hkv
     bq, bk = _block_sizes(t, bq, bk, causal)
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
 
-    # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA.
+    # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA; stored
+    # [B, H, T, 1] in BOTH layouts (tiny, consumed by the kernels only).
     # When the caller also consumes lse (flash_attention_lse), its
     # cotangent folds in exactly here: dL/dz_ij = p_ij (dp_ij - delta_i
     # + dlse_i), since dlse_i/dz_ij = p_ij — so delta_eff = delta - dlse.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [B, H, T, 1]
+    )
+    if layout == "bthc":
+        delta = jnp.transpose(delta, (0, 2, 1, 3))  # [B, H, T, 1]
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
+    row_q34 = lambda b_, h_, iq, ik: iq  # noqa: E731 — grid (b,h,iq,ik)
+    row_k34 = lambda b_, h_, iq, ik: ik  # noqa: E731
+    row_q43 = lambda b_, h_, ik, iq: iq  # noqa: E731 — grid (b,h,ik,iq)
+    row_k43 = lambda b_, h_, ik, iq: ik  # noqa: E731
+    kv_head = lambda h_: h_ // groups  # noqa: E731
+    q_head = lambda h_: h_  # noqa: E731
+
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            layout=layout,
         ),
         grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, iq, ik: (b_, h_ // groups, ik, 0)
-            ),
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            _act_spec(layout, bq, c, row_q34, q_head),
+            _act_spec(layout, bk, c, row_k34, kv_head),
+            _act_spec(layout, bk, c, row_k34, kv_head),
+            _act_spec(layout, bq, c, row_q34, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, bq, c), lambda b_, h_, iq, ik: (b_, h_, iq, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
+        out_specs=_act_spec(layout, bq, c, row_q34, q_head),
+        out_shape=jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, c), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -331,28 +389,25 @@ def _flash_backward(
     # dK/dV per Q-head (summed over GQA groups afterwards)
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
+            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            layout=layout,
         ),
         grid=(b, h, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_ // groups, ik, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_ // groups, ik, 0)
-            ),
-            pl.BlockSpec((1, 1, bq, c), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            _act_spec(layout, bq, c, row_q43, q_head),
+            _act_spec(layout, bk, c, row_k43, kv_head),
+            _act_spec(layout, bk, c, row_k43, kv_head),
+            _act_spec(layout, bq, c, row_q43, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, c), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            _act_spec(layout, bk, c, row_k43, q_head),
+            _act_spec(layout, bk, c, row_k43, q_head),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, c), k.dtype),
-            jax.ShapeDtypeStruct((b, h, t, c), v.dtype),
+            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), k.dtype),
+            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, c), jnp.float32),
@@ -364,8 +419,12 @@ def _flash_backward(
     )(q, k, v, do, lse, delta)
 
     if groups > 1:
-        dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
-        dv = dv_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(v.dtype)
+        if layout == "bhtc":
+            dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
+            dv = dv_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(v.dtype)
+        else:
+            dk = dk_h.reshape(b, t, hkv, groups, c).sum(axis=3).astype(k.dtype)
+            dv = dv_h.reshape(b, t, hkv, groups, c).sum(axis=3).astype(v.dtype)
     else:
         dk, dv = dk_h, dv_h
     return dq, dk, dv
@@ -383,16 +442,17 @@ def flash_attention(
     causal: bool = True,
     block_q: tp.Optional[int] = None,
     block_k: tp.Optional[int] = None,
+    layout: str = "bhtc",
 ) -> Array:
     """Flash attention output only — delegates to flash_attention_lse (the
     dropped lse's cotangent instantiates to zeros, making the backward's
     ``delta - dlse`` fold a no-op), so there is a single VJP pair to
     maintain."""
-    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k)
+    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k, layout)
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_lse(
     q: Array,
     k: Array,
@@ -400,28 +460,34 @@ def flash_attention_lse(
     causal: bool = True,
     block_q: tp.Optional[int] = None,
     block_k: tp.Optional[int] = None,
+    layout: str = "bhtc",
 ) -> tp.Tuple[Array, Array]:
-    """Flash attention returning (out [B,H,T,C], lse [B,H,T]).
+    """Flash attention returning (out in ``layout``, lse [B,H,T]).
 
     The lse output is differentiable — its cotangent folds into the
     backward kernels as ``delta - dlse`` (see _flash_backward) — which is
     what lets ring attention (midgpt_tpu.parallel.ring) run this kernel
     per hop and still autodiff through the streaming LSE merge."""
-    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k, layout=layout
+    )
     return out, lse[..., 0]
 
 
-def _lse_vjp_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
+def _lse_vjp_fwd(q, k, v, causal, block_q, block_k, layout):
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k, layout=layout
+    )
     return (out, lse[..., 0]), (q, k, v, out, lse)
 
 
-def _lse_vjp_bwd(causal, block_q, block_k, residuals, cts):
+def _lse_vjp_bwd(causal, block_q, block_k, layout, residuals, cts):
     q, k, v, out, lse = residuals
     do, dlse = cts
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, do,
         causal=causal, bq=block_q, bk=block_k, dlse=dlse[..., None],
+        layout=layout,
     )
     return dq, dk, dv
 
