@@ -1,0 +1,115 @@
+"""One-shot on-hardware validation for the transpose-free flash layout.
+
+    python scripts/validate_bthc.py
+
+Run this FIRST THING in a session with a live TPU (relay died before it
+could run in r2 — see PERF.md). It:
+ 1. checks bthc-vs-bhtc fwd/bwd parity on the chip (Mosaic, not interpret);
+ 2. times both layouts at the 124M bench shape;
+ 3. prints the verdict: if bthc compiles and is faster, flip the default in
+    midgpt_tpu/config.py (ModelConfig.attn_layout) and re-run bench.py.
+
+Runs detached-friendly (no timeout-kill mid-RPC — PERF.md post-mortem).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    from midgpt_tpu.ops.flash import flash_attention
+
+    b, h, t, c = 16, 12, 1024, 64
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, c), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, t, c), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, t, c), jnp.bfloat16)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    # 1. parity on hardware
+    out_ref = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
+    out_t = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, layout="bthc")
+    )(qt, kt, vt)
+    diff = float(
+        jnp.max(
+            jnp.abs(
+                jnp.transpose(out_t, (0, 2, 1, 3)).astype(jnp.float32)
+                - out_ref.astype(jnp.float32)
+            )
+        )
+    )
+    print(f"fwd parity max|diff|: {diff:.2e}")
+    assert diff < 1e-2, "bthc fwd mismatch on hardware"
+
+    g_ref = jax.jit(
+        jax.grad(lambda q: flash_attention(q, k, v).astype(jnp.float32).sum())
+    )(q)
+    g_t = jax.jit(
+        jax.grad(
+            lambda qt: flash_attention(qt, kt, vt, layout="bthc")
+            .astype(jnp.float32)
+            .sum()
+        )
+    )(qt)
+    gdiff = float(
+        jnp.max(
+            jnp.abs(
+                jnp.transpose(g_t, (0, 2, 1, 3)).astype(jnp.float32)
+                - g_ref.astype(jnp.float32)
+            )
+        )
+    )
+    print(f"bwd parity max|diff|: {gdiff:.2e}")
+    assert gdiff < 1e-2, "bthc bwd mismatch on hardware"
+
+    # 2. timing (chained inside one dispatch)
+    def scan_time(fn, init, iters=10):
+        @jax.jit
+        def run(x):
+            def body(x, _):
+                return fn(x), None
+
+            out, _ = jax.lax.scan(body, x, None, length=iters)
+            return out
+
+        jax.block_until_ready(run(init))
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(init))
+        return (time.perf_counter() - t0) / iters
+
+    t_ref = scan_time(
+        lambda q: flash_attention(q, k, v).astype(jnp.bfloat16), q
+    )
+    t_t = scan_time(
+        lambda qt: flash_attention(qt, kt, vt, layout="bthc").astype(
+            jnp.bfloat16
+        ),
+        qt,
+    )
+    print(f"fwd bhtc {t_ref*1e3:.2f} ms   bthc {t_t*1e3:.2f} ms")
+    print(
+        "VERDICT: bthc OK on hardware — flip ModelConfig.attn_layout "
+        "default to 'bthc' and re-run bench.py"
+        if t_t <= t_ref * 1.05
+        else "VERDICT: bthc compiles but is not faster in isolation; "
+        "still worth a full bench.py A/B (the win is the removed "
+        "transposes outside the kernel)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -21,16 +21,26 @@ def _cfg(mesh: MeshConfig) -> ExperimentConfig:
     )
 
 
-@pytest.mark.slow
-def test_restore_across_mesh_change(tmp_path):
+@pytest.fixture(scope="module")
+def saved_mesh_a(tmp_path_factory):
+    """State initialized + checkpointed on mesh A, shared by the migration
+    tests (the 8-device init and save only run once per session)."""
     cfg_a = _cfg(MeshConfig(replica=1, fsdp=4, sequence=1, tensor=2))
     mesh_a = create_mesh(cfg_a.mesh)
     tx, _ = make_optimizer(cfg_a)
     state_a = init_state(cfg_a, mesh_a, tx, jax.random.PRNGKey(0))
-
-    ckpt = Checkpointer(str(tmp_path / "run"), save_interval_steps=1)
+    rundir = str(tmp_path_factory.mktemp("ckpt_mig") / "run")
+    ckpt = Checkpointer(rundir, save_interval_steps=1)
     ckpt.save(0, _ckpt_items(state_a), meta={"step": 0}, force=True)
     ckpt.wait()
+    yield state_a, tx, rundir
+    ckpt.close()
+
+
+@pytest.mark.slow
+def test_restore_across_mesh_change(saved_mesh_a):
+    state_a, tx, rundir = saved_mesh_a
+    ckpt = Checkpointer(rundir, save_interval_steps=1)
 
     # new topology: fsdp halved, sequence axis introduced
     cfg_b = _cfg(MeshConfig(replica=1, fsdp=2, sequence=2, tensor=2))
@@ -67,4 +77,33 @@ def test_restore_across_mesh_change(tmp_path):
         )
         if hasattr(r, "sharding") and hasattr(b, "sharding"):
             assert r.sharding == b.sharding, (r.sharding, b.sharding)
+    ckpt.close()
+
+
+@pytest.mark.slow
+def test_restore_into_pipeline_topology(saved_mesh_a):
+    """Save on a plain FSDP mesh, resume on a pipeline-parallel mesh: the
+    stacked block params must land sharded over the 'pipeline' axis
+    (GPT_PP_PARAM_RULES) with the saved values — the 'add PP mid-training'
+    migration."""
+    state_a, tx, rundir = saved_mesh_a
+    ckpt = Checkpointer(rundir, save_interval_steps=1)
+
+    cfg_b = _cfg(MeshConfig(pipeline=2, replica=1, fsdp=2, sequence=1, tensor=2))
+    mesh_b = create_mesh(cfg_b.mesh)
+    state_b = init_state(cfg_b, mesh_b, tx, jax.random.PRNGKey(7))
+    # PP rules: stacked block leaves carry 'pipeline' on the layer axis
+    spec_b = state_b.params.blocks.attn.wqkv.weight.sharding.spec
+    assert spec_b[0] == "pipeline", spec_b
+
+    items, _ = ckpt.restore(_ckpt_items(state_b))
+    restored = items["params"]
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(restored.blocks.attn.wqkv.weight)),
+        np.asarray(jax.device_get(state_a.params.blocks.attn.wqkv.weight)),
+    )
+    assert (
+        restored.blocks.attn.wqkv.weight.sharding
+        == state_b.params.blocks.attn.wqkv.weight.sharding
+    )
     ckpt.close()
