@@ -64,6 +64,11 @@ class Checkpointer:
         every iteration). ``force=True`` saves regardless of the interval
         (end-of-run checkpoint)."""
         assert "meta" not in items, "'meta' is reserved for the JSON metadata"
+        if force and step in self._mngr.all_steps():
+            # already durable (e.g. Orbax saves step 0 regardless of the
+            # interval; a preemption force-save of the same step would
+            # raise StepAlreadyExistsError)
+            return False
         return self._mngr.save(
             step,
             args=ocp.args.Composite(

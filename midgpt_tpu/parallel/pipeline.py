@@ -192,8 +192,26 @@ def gpt_pipeline_hidden(
     )
     b, t = tokens.shape
     s = mesh.shape[axis]
-    m = n_micro or 2 * s
-    assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
+    if n_micro:
+        m = n_micro
+        assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
+    else:
+        # auto: aim for 2 microbatches per stage (bubble (S-1)/(M+S-1)),
+        # clamped to the largest divisor of the batch — grad-accumulation
+        # microsteps can hand this a batch smaller than 2*S
+        m = min(2 * s, b)
+        while b % m:
+            m -= 1
+        if m < s:
+            import warnings
+
+            warnings.warn(
+                f"pipeline auto-microbatching degraded to {m} microbatches "
+                f"for batch {b} over {s} stages (bubble "
+                f"{(s - 1) / (m + s - 1):.0%}); pick a batch divisible by "
+                f"2*pipeline or set MeshConfig.pp_microbatches",
+                stacklevel=2,
+            )
     sin, cos = rope_tables(cfg.head_dim, t, cfg.rope_base)
     impl = cfg.attn_impl
 

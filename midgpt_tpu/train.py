@@ -288,194 +288,237 @@ def _ckpt_items(state: TrainState) -> tp.Dict[str, tp.Any]:
 
 
 def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
-    """The orchestrator (parity: train.py:127-225). Returns final metrics."""
+    """The orchestrator (parity: train.py:127-225). Returns final metrics.
+
+    Preemption-safe: on SIGTERM (the TPU-VM maintenance/preemption signal)
+    the loop finishes the in-flight step, force-saves a checkpoint, and
+    returns cleanly — resume loses at most one step instead of
+    ``ckpt_interval`` steps. The reference's recovery story is
+    restart-from-last-interval-checkpoint only (SURVEY.md 5.3)."""
+    import signal
+
     assert cfg.rundir, "rundir required"
-    mesh = create_mesh(cfg.mesh)
-    n_proc = jax.process_count()
-    proc = jax.process_index()
+    stop_requested = {"flag": False}
+    prev_handler = None
 
-    # per-process local batch (global batch split over processes)
-    assert cfg.batch_size % (cfg.g_accum_iters * n_proc) == 0
-    local_b = cfg.batch_size // (cfg.g_accum_iters * n_proc)
-    t = cfg.model.block_size
-
-    train_loader = Loader(
-        shard=load_shard(os.path.join(cfg.data_dir, "train.bin"), proc, n_proc),
-        block_size=t,
-        batch_shape=(cfg.g_accum_iters, local_b),
-        seed=cfg.data_seed,
-        process_index=proc,
-    )
-    val_loader = Loader(
-        shard=load_shard(os.path.join(cfg.data_dir, "val.bin"), proc, n_proc),
-        block_size=t,
-        batch_shape=(1, local_b),
-        seed=cfg.data_seed,
-        process_index=proc,
-        stream=1,
-    )
-    # train-split eval gets its own single-microbatch loader (evaluate uses
-    # one microbatch; peeking the full (g_accum, B) train shape would gather
-    # g_accum x the data only to discard all but the first slice)
-    train_eval_loader = Loader(
-        shard=train_loader.shard,
-        block_size=t,
-        batch_shape=(1, local_b),
-        seed=cfg.data_seed,
-        process_index=proc,
-        stream=2,
-    )
-
-    tx, schedule = make_optimizer(cfg)
-    train_step = make_train_step(cfg, tx, mesh)
-    eval_step = make_eval_step(cfg, mesh)
-
-    ckpt = Checkpointer(
-        cfg.rundir,
-        keep=cfg.ckpt_keep,
-        save_interval_steps=(
-            cfg.ckpt_interval if cfg.ckpt_interval is not None else cfg.eval_interval
-        ),
-        async_save=not cfg.debug,
-    )
-    logger = MetricLogger(cfg.rundir, cfg, use_wandb=cfg.use_wandb)
-    # fingerprint covers only fields that change the math/parameters —
-    # runtime implementation knobs (kernel choice, remat, unroll) may vary
-    # freely between save and resume
-    _impl_knobs = ("attn_impl", "attn_layout", "norm_impl", "remat", "scan_unroll")
-    fingerprint = config_fingerprint(
-        {k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs}
-    )
-
-    key = jax.random.PRNGKey(cfg.seed)
-    state = init_state(cfg, mesh, tx, key)
-    if proc == 0:
-        n_params = count_params(state.params)
-        print(f"parameters (non-embedding): {n_params/1e6:.2f}M")
-
-    first_step = 0
-    if ckpt.latest_step() is not None:
-        items, meta = ckpt.restore(_ckpt_items(state))
-        state = TrainState(
-            params=items["params"],
-            opt_state=items["opt_state"],
-            step=items["extra"]["step"],
-        )
-        assert meta.get("model_fingerprint") == fingerprint, (
-            "checkpoint was trained with a different model config"
-        )
-        train_loader.load_state_dict(meta["loader"])
-        first_step = int(meta["step"]) + 1
-        if proc == 0:
-            print(f"resumed from step {meta['step']}")
-
-    batch_spec = P(None, ("replica", "fsdp"), "sequence")
-    # next batch is gathered + device_put on a background thread while the
-    # current step runs (the reference pays this on the critical path,
-    # train.py:203-207)
-    prefetch = PrefetchLoader(
-        train_loader,
-        transform=lambda x, y: (
-            make_global_array(x, mesh, batch_spec),
-            make_global_array(y, mesh, batch_spec),
-        ),
-    ).start()
-    tokens_per_step = cfg.batch_size * t
-    last_log_time, last_log_step = time.time(), first_step
-    final: tp.Dict[str, float] = {}
+    def _on_sigterm(signum, frame):
+        stop_requested["flag"] = True
 
     try:
-        from tqdm import tqdm
+        prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # non-main thread (tests driving train() directly)
+        prev_handler = None
+    try:
+        mesh = create_mesh(cfg.mesh)
+        n_proc = jax.process_count()
+        proc = jax.process_index()
 
-        pbar = tqdm(
-            range(first_step, cfg.max_steps),
-            initial=first_step,
-            total=cfg.max_steps,
-            disable=proc != 0,
+        # per-process local batch (global batch split over processes)
+        assert cfg.batch_size % (cfg.g_accum_iters * n_proc) == 0
+        local_b = cfg.batch_size // (cfg.g_accum_iters * n_proc)
+        t = cfg.model.block_size
+
+        train_loader = Loader(
+            shard=load_shard(os.path.join(cfg.data_dir, "train.bin"), proc, n_proc),
+            block_size=t,
+            batch_shape=(cfg.g_accum_iters, local_b),
+            seed=cfg.data_seed,
+            process_index=proc,
         )
-    except ImportError:  # pragma: no cover
-        pbar = range(first_step, cfg.max_steps)
+        val_loader = Loader(
+            shard=load_shard(os.path.join(cfg.data_dir, "val.bin"), proc, n_proc),
+            block_size=t,
+            batch_shape=(1, local_b),
+            seed=cfg.data_seed,
+            process_index=proc,
+            stream=1,
+        )
+        # train-split eval gets its own single-microbatch loader (evaluate uses
+        # one microbatch; peeking the full (g_accum, B) train shape would gather
+        # g_accum x the data only to discard all but the first slice)
+        train_eval_loader = Loader(
+            shard=train_loader.shard,
+            block_size=t,
+            batch_shape=(1, local_b),
+            seed=cfg.data_seed,
+            process_index=proc,
+            stream=2,
+        )
 
-    loss = None
-    for itr in pbar:
-        if itr % cfg.eval_interval == 0 and itr > first_step:
-            n_eval = 1 if cfg.debug else cfg.eval_batches
-            train_loss = evaluate(
-                eval_step, state.params, train_eval_loader, mesh, n_eval, itr
+        tx, schedule = make_optimizer(cfg)
+        train_step = make_train_step(cfg, tx, mesh)
+        eval_step = make_eval_step(cfg, mesh)
+
+        ckpt = Checkpointer(
+            cfg.rundir,
+            keep=cfg.ckpt_keep,
+            save_interval_steps=(
+                cfg.ckpt_interval if cfg.ckpt_interval is not None else cfg.eval_interval
+            ),
+            async_save=not cfg.debug,
+        )
+        logger = MetricLogger(cfg.rundir, cfg, use_wandb=cfg.use_wandb)
+        # fingerprint covers only fields that change the math/parameters —
+        # runtime implementation knobs (kernel choice, remat, unroll) may vary
+        # freely between save and resume
+        _impl_knobs = ("attn_impl", "attn_layout", "norm_impl", "remat", "scan_unroll")
+        fingerprint = config_fingerprint(
+            {k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs}
+        )
+
+        key = jax.random.PRNGKey(cfg.seed)
+        state = init_state(cfg, mesh, tx, key)
+        if proc == 0:
+            n_params = count_params(state.params)
+            print(f"parameters (non-embedding): {n_params/1e6:.2f}M")
+
+        first_step = 0
+        if ckpt.latest_step() is not None:
+            items, meta = ckpt.restore(_ckpt_items(state))
+            state = TrainState(
+                params=items["params"],
+                opt_state=items["opt_state"],
+                step=items["extra"]["step"],
             )
-            val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, itr)
-            logger.log(itr, {"loss/train": train_loss, "loss/val": val_loss})
-            final.update({"train_loss": train_loss, "val_loss": val_loss})
+            assert meta.get("model_fingerprint") == fingerprint, (
+                "checkpoint was trained with a different model config"
+            )
+            train_loader.load_state_dict(meta["loader"])
+            first_step = int(meta["step"]) + 1
+            if proc == 0:
+                print(f"resumed from step {meta['step']}")
 
-        xg, yg = prefetch.next()
-        step_key = jax.random.fold_in(key, itr)
+        batch_spec = P(None, ("replica", "fsdp"), "sequence")
+        # next batch is gathered + device_put on a background thread while the
+        # current step runs (the reference pays this on the critical path,
+        # train.py:203-207)
+        prefetch = PrefetchLoader(
+            train_loader,
+            transform=lambda x, y: (
+                make_global_array(x, mesh, batch_spec),
+                make_global_array(y, mesh, batch_spec),
+            ),
+        ).start()
+        tokens_per_step = cfg.batch_size * t
+        last_log_time, last_log_step = time.time(), first_step
+        final: tp.Dict[str, float] = {}
 
-        if cfg.debug and itr == first_step + 1 and not cfg.rundir.startswith("gs://"):
-            # profile exactly one post-warmup step (parity: train.py:205-211)
-            with jax.profiler.trace(os.path.join(cfg.rundir, "profile")):
-                state, loss = train_step(state, xg, yg, step_key)
-                jax.block_until_ready(loss)
-        else:
-            state, loss = train_step(state, xg, yg, step_key)
+        try:
+            from tqdm import tqdm
 
-        if itr % cfg.log_interval == 0 and itr > 0:
-            loss_v = float(loss)
-            now = time.time()
-            tps = tokens_per_step * (itr - last_log_step) / max(now - last_log_time, 1e-9)
-            last_log_time, last_log_step = now, itr
-            metrics = {
-                "loss/optimized": loss_v,
-                "lr": float(schedule(itr)),
-                "tokens_per_sec": tps,
-                "mfu": mfu(tps, cfg.model, jax.device_count()),
-            }
-            logger.log(itr, metrics)
-            if hasattr(pbar, "set_postfix"):
-                pbar.set_postfix(
-                    loss=f"{loss_v:.3f}",
-                    tps=f"{tps:,.0f}",
-                    mfu=f"{metrics['mfu']:.1%}",
+            pbar = tqdm(
+                range(first_step, cfg.max_steps),
+                initial=first_step,
+                total=cfg.max_steps,
+                disable=proc != 0,
+            )
+        except ImportError:  # pragma: no cover
+            pbar = range(first_step, cfg.max_steps)
+
+        loss = None
+        for itr in pbar:
+            if itr % cfg.eval_interval == 0 and itr > first_step:
+                n_eval = 1 if cfg.debug else cfg.eval_batches
+                train_loss = evaluate(
+                    eval_step, state.params, train_eval_loader, mesh, n_eval, itr
                 )
-            final["loss"] = loss_v
-            final["tokens_per_sec"] = tps
-            final["mfu"] = metrics["mfu"]
+                val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, itr)
+                logger.log(itr, {"loss/train": train_loss, "loss/val": val_loss})
+                final.update({"train_loss": train_loss, "val_loss": val_loss})
 
-        if not cfg.debug:
+            xg, yg = prefetch.next()
+            step_key = jax.random.fold_in(key, itr)
+
+            if cfg.debug and itr == first_step + 1 and not cfg.rundir.startswith("gs://"):
+                # profile exactly one post-warmup step (parity: train.py:205-211)
+                with jax.profiler.trace(os.path.join(cfg.rundir, "profile")):
+                    state, loss = train_step(state, xg, yg, step_key)
+                    jax.block_until_ready(loss)
+            else:
+                state, loss = train_step(state, xg, yg, step_key)
+
+            if itr % cfg.log_interval == 0 and itr > 0:
+                loss_v = float(loss)
+                now = time.time()
+                tps = tokens_per_step * (itr - last_log_step) / max(now - last_log_time, 1e-9)
+                last_log_time, last_log_step = now, itr
+                metrics = {
+                    "loss/optimized": loss_v,
+                    "lr": float(schedule(itr)),
+                    "tokens_per_sec": tps,
+                    "mfu": mfu(tps, cfg.model, jax.device_count()),
+                }
+                logger.log(itr, metrics)
+                if hasattr(pbar, "set_postfix"):
+                    pbar.set_postfix(
+                        loss=f"{loss_v:.3f}",
+                        tps=f"{tps:,.0f}",
+                        mfu=f"{metrics['mfu']:.1%}",
+                    )
+                final["loss"] = loss_v
+                final["tokens_per_sec"] = tps
+                final["mfu"] = metrics["mfu"]
+
+            if not cfg.debug:
+                # force on preemption: the completed step becomes durable
+                # even off the save interval (Checkpointer no-ops the force
+                # when the interval save already owns this step)
+                ckpt.save(
+                    itr,
+                    _ckpt_items(state),
+                    meta={
+                        "step": itr,
+                        "loader": prefetch.state_dict(),
+                        "model_fingerprint": fingerprint,
+                        "config": to_dict(cfg),
+                    },
+                    force=stop_requested["flag"],
+                )
+
+            if stop_requested["flag"]:
+                if proc == 0:
+                    print(f"SIGTERM: checkpointed step {itr}, exiting")
+                final["interrupted_at"] = itr
+                break
+
+        prefetch.stop()
+        if "interrupted_at" in final:
+            # preempted: the in-loop force-save owns the last completed step;
+            # a max_steps-1 save here would mislabel partial progress
+            ckpt.close()
+            logger.close()
+            return final
+
+        # final eval + forced save of the last completed step (max_steps - 1;
+        # the in-loop convention is "meta step == completed itr")
+        n_eval = 1 if cfg.debug else cfg.eval_batches
+        final["val_loss"] = evaluate(
+            eval_step, state.params, val_loader, mesh, n_eval, cfg.max_steps
+        )
+        logger.log(cfg.max_steps, {"loss/val": final["val_loss"]})
+        if (
+            not cfg.debug
+            and cfg.max_steps > first_step
+            and ckpt.latest_step() != cfg.max_steps - 1  # in-loop save may own it
+        ):
             ckpt.save(
-                itr,
+                cfg.max_steps - 1,
                 _ckpt_items(state),
                 meta={
-                    "step": itr,
+                    "step": cfg.max_steps - 1,
                     "loader": prefetch.state_dict(),
                     "model_fingerprint": fingerprint,
                     "config": to_dict(cfg),
                 },
+                force=True,
             )
-
-    prefetch.stop()
-    # final eval + forced save of the last completed step (max_steps - 1;
-    # the in-loop convention is "meta step == completed itr")
-    n_eval = 1 if cfg.debug else cfg.eval_batches
-    final["val_loss"] = evaluate(
-        eval_step, state.params, val_loader, mesh, n_eval, cfg.max_steps
-    )
-    logger.log(cfg.max_steps, {"loss/val": final["val_loss"]})
-    if (
-        not cfg.debug
-        and cfg.max_steps > first_step
-        and ckpt.latest_step() != cfg.max_steps - 1  # in-loop save may own it
-    ):
-        ckpt.save(
-            cfg.max_steps - 1,
-            _ckpt_items(state),
-            meta={
-                "step": cfg.max_steps - 1,
-                "loader": prefetch.state_dict(),
-                "model_fingerprint": fingerprint,
-                "config": to_dict(cfg),
-            },
-            force=True,
-        )
-    ckpt.close()
-    logger.close()
-    return final
+        ckpt.close()
+        logger.close()
+        return final
+    finally:
+        # restore the previous handler only once everything that must
+        # complete under our protection (async checkpoint flush in
+        # ckpt.close()) is done — a second SIGTERM mid-flush must not
+        # kill the process through a prematurely restored default
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)

@@ -185,3 +185,37 @@ def test_gpt_pp_composes_with_tensor_parallel():
     # psum reductions — different bf16 summation order, so slightly looser
     # than the PP-only parity above
     np.testing.assert_allclose(loss_pp_tp, loss_plain, rtol=5e-4)
+
+
+def test_gpt_pp_with_grad_accumulation():
+    """The GPipe shard_map nests inside the grad-accumulation scan."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.config import ExperimentConfig, MeshConfig, ModelConfig
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+
+    model_cfg = ModelConfig(
+        block_size=64, vocab_size=128, n_layer=4, n_head=4, n_embd=32,
+        dropout=0.0, attn_impl="naive", remat="none",
+    )
+    cfg = ExperimentConfig(
+        model=model_cfg,
+        mesh=MeshConfig(pipeline=4, replica=1, fsdp=2, sequence=1, tensor=1),
+        learning_rate=1e-3, warmup_steps=2, lr_decay_steps=10, max_steps=10,
+        batch_size=8, g_accum_iters=2,
+    )
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tx, mesh)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 128, size=(2, 4, 64), dtype=np.int32)
+    y = rng.integers(0, 128, size=(2, 4, 64), dtype=np.int32)
+    spec = P(None, ("replica", "fsdp"), "sequence")
+    xg = make_global_array(x, mesh, spec)
+    yg = make_global_array(y, mesh, spec)
+    state, loss = step(state, xg, yg, jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
