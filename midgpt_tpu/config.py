@@ -41,10 +41,6 @@ class ModelConfig:
     # False = reference semantics: shared init, independent params
     # (model.py:134-138, SURVEY.md 2.3)
     attn_impl: str = "auto"  # auto | naive | flash | ring
-    # flash activation layout: "bhtc" ([B,H,T,C], classic, TPU-validated) |
-    # "bthc" ([B,T,H,C], transpose-free fast path — interpret-mode-verified;
-    # flip on after one on-hardware parity check, see PERF.md r2)
-    attn_layout: str = "bhtc"
     ring_schedule: str = "zigzag"  # zigzag (balanced) | standard; zigzag
     # auto-falls back to standard when T doesn't divide 2*sequence
     norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)

@@ -37,7 +37,7 @@ from midgpt_tpu.models.layers import (
     dropout,
     rope_tables,
 )
-from midgpt_tpu.ops.attention import attention, resolve_impl
+from midgpt_tpu.ops.attention import attention
 from midgpt_tpu.parallel.sharding import current_mesh, shard_act
 from midgpt_tpu.pytree import module, static
 
@@ -57,17 +57,12 @@ class Attention:
     n_kv_head: int = static()
     dropout_rate: float = static(default=0.0)
     ring_schedule: str = static(default="zigzag")
-    attn_layout: str = static(default="bhtc")
 
     @staticmethod
     def init(key: KeyArray, cfg: ModelConfig) -> "Attention":
         k1, k2 = jax.random.split(key)
         c = cfg.head_dim
         hkv = cfg.kv_heads
-        layout = getattr(cfg, "attn_layout", "bhtc")
-        assert layout in ("bhtc", "bthc"), (
-            f"unknown attn_layout {layout!r}; expected 'bhtc' or 'bthc'"
-        )
         qkv_out = (cfg.n_head + 2 * hkv) * c
         return Attention(
             wqkv=Linear.init(k1, cfg.n_embd, qkv_out),
@@ -78,7 +73,6 @@ class Attention:
             n_kv_head=hkv,
             dropout_rate=cfg.dropout,
             ring_schedule=cfg.ring_schedule,
-            attn_layout=layout,
         )
 
     def __call__(
@@ -98,17 +92,6 @@ class Attention:
         adrop_key, pdrop_key = (
             jax.random.split(key) if key is not None else (None, None)
         )
-        # cheap gates first; resolve_impl only when the fast path is viable.
-        # Attention dropout excludes flash in BOTH layouts (the bhtc branch
-        # must not bypass the assert the dispatcher enforces on bhtc).
-        use_fast_path = (
-            self.attn_layout == "bthc"
-            and not return_kv
-            and impl != "ring"
-            and (self.dropout_rate == 0.0 or deterministic)
-            and resolve_impl(impl, t, self.dropout_rate, deterministic)
-            == "flash"
-        )
         with jax.named_scope("attention"):
             qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
             q = qkv[..., : h * c].reshape(b, t, h, c)
@@ -117,23 +100,6 @@ class Attention:
             if self.q_norm is not None:
                 q = self.q_norm(q)
                 k = self.k_norm(k)
-            if use_fast_path:
-                # transpose-free fast path: the kernel reads the
-                # projection-natural [B, T, H, C] layout directly — skips
-                # four [B,T,H,C]<->[B,H,T,C] copies per call (x2 in bwd),
-                # ~8 ms/step at the 124M bench shape (PERF.md)
-                from midgpt_tpu.ops.flash import flash_attention
-
-                q = apply_rotary(q, sin, cos, seq_axis=1)
-                k = apply_rotary(k, sin, cos, seq_axis=1)
-                q = shard_act(q, "batch", "seq", "heads", "head_dim")
-                k = shard_act(k, "batch", "seq", "kv_heads", "head_dim")
-                v = shard_act(v, "batch", "seq", "kv_heads", "head_dim")
-                out = flash_attention(q, k, v, causal=True, layout="bthc")
-                out = out.reshape(b, t, h * c)
-                out = self.wo(out)
-                out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
-                return shard_act(out, "batch", "seq", "embed")
             # [B, H, T, C]
             q = jnp.transpose(q, (0, 2, 1, 3))
             k = jnp.transpose(k, (0, 2, 1, 3))

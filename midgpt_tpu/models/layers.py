@@ -198,24 +198,14 @@ def apply_rotary(
     x: Array,
     sin: tp.Union[Array, np.ndarray],
     cos: tp.Union[Array, np.ndarray],
-    seq_axis: int = -2,
 ) -> Array:
-    """Apply interleaved RoPE. ``x``: [..., C] with the sequence dim at
-    ``seq_axis`` (default [..., T, C]); sin/cos: [T, C//2]
-    (parity: layers.py:92-99). ``seq_axis=1`` serves the transpose-free
-    [B, T, H, C] attention layout."""
+    """Apply interleaved RoPE. ``x``: [..., T, C]; sin/cos: [T, C//2]
+    (parity: layers.py:92-99)."""
     with jax.named_scope("rope"):
         sin = jnp.asarray(sin, dtype=x.dtype)
         cos = jnp.asarray(cos, dtype=x.dtype)
         sin_full = _duplicate_interleaved(sin)  # [T, C]
         cos_full = _duplicate_interleaved(cos)
-        ax = seq_axis % x.ndim
-        if ax != x.ndim - 2:
-            shape = [1] * x.ndim
-            shape[ax] = sin_full.shape[0]
-            shape[-1] = sin_full.shape[1]
-            sin_full = sin_full.reshape(shape)
-            cos_full = cos_full.reshape(shape)
         rot = jnp.asarray(_rotation_matrix(x.shape[-1], x.dtype.name))
         return x * cos_full + (x @ rot) * sin_full
 

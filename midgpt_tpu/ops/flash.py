@@ -6,14 +6,13 @@ scores never materialize in HBM; softmax runs in float32 with the 1/sqrt(C)
 scale folded into the softmax argument, exactly mirroring the reference
 numerics (SURVEY.md 2.3).
 
-Layouts: ``"bhtc"`` ([B, H, T, C], the classic flash layout) or ``"bthc"``
-([B, T, H, C], the projection-natural layout) — the latter lets the model
-skip four [B,T,H,C]<->[B,H,T,C] transpose materializations per attention
-call (q/k/v in, out; doubled again in the backward), which profiling showed
-as ~8 ms/step of pure copies at the 124M bench shape. The kernel grid is
-identical; only the BlockSpec index maps change. K/V may carry fewer
-(grouped) heads — the grid maps each Q head to its KV group, so
-tensor-parallel head sharding composes (each shard sees a smaller H).
+Layout is [B, H, T, C] — the only layout Mosaic can block per-head: the
+last two block dims must be (multiple-of-8, multiple-of-128-or-full), so a
+projection-natural [B, T, H, C] per-head block (1, rows, 1, C) is illegal
+on hardware (measured r2; see PERF.md "transpose-free layout post-mortem").
+K/V may carry fewer (grouped) heads — the grid maps each Q head to its KV
+group, so tensor-parallel head sharding composes (each shard sees a
+smaller H).
 
 Forward residual is the standard (out, logsumexp) pair; backward runs two
 kernels (dQ over Q blocks; dK/dV over KV blocks) plus a trivial elementwise
@@ -72,40 +71,15 @@ def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
     return rows >= cols
 
 
-# --- layout plumbing: "bhtc" [B,H,T,C] vs "bthc" [B,T,H,C] ----------------
-
-
-def _act_spec(layout: str, rows: int, c: int, row_fn, head_fn):
+def _act_spec(rows: int, c: int, row_fn, head_fn):
     """BlockSpec for a q/k/v/o/do activation carrying ``rows`` sequence rows.
 
     ``row_fn(grid indices) -> row-block index``; ``head_fn(h) -> head (or KV
-    group) index``. The kernel always sees a [rows, c] tile; only where that
-    tile sits in the global array depends on the layout."""
-    if layout == "bhtc":
-        return pl.BlockSpec(
-            (1, 1, rows, c),
-            lambda *g: (g[0], head_fn(g[1]), row_fn(*g), 0),
-        )
-    assert layout == "bthc", layout
+    group) index``. The kernel always sees a [rows, c] tile."""
     return pl.BlockSpec(
-        (1, rows, 1, c),
-        lambda *g: (g[0], row_fn(*g), head_fn(g[1]), 0),
+        (1, 1, rows, c),
+        lambda *g: (g[0], head_fn(g[1]), row_fn(*g), 0),
     )
-
-
-def _read(layout: str, ref) -> Array:
-    return ref[0, 0] if layout == "bhtc" else ref[0, :, 0, :]
-
-
-def _write(layout: str, ref, value) -> None:
-    if layout == "bhtc":
-        ref[0, 0] = value
-    else:
-        ref[0, :, 0, :] = value
-
-
-def _act_shape(layout: str, b: int, h: int, t: int, c: int):
-    return (b, h, t, c) if layout == "bhtc" else (b, t, h, c)
 
 
 # ---------------------------------------------------------------------------
@@ -115,7 +89,7 @@ def _act_shape(layout: str, b: int, h: int, t: int, c: int):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int, layout: str,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
@@ -130,9 +104,9 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = _read(layout, q_ref)  # [bq, C]
-        k = _read(layout, k_ref)  # [bk, C]
-        v = _read(layout, v_ref)  # [bk, C]
+        q = q_ref[0, 0]  # [bq, C]
+        k = k_ref[0, 0]  # [bk, C]
+        v = v_ref[0, 0]  # [bk, C]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
@@ -165,25 +139,15 @@ def _fwd_kernel(
         m = m_ref[:, :1]
         l = l_ref[:, :1]
         # causal rows always have >= 1 visible key, so l > 0
-        _write(layout, o_ref, (acc_ref[:] / l).astype(o_ref.dtype))
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
         lse_ref[0, 0] = m + jnp.log(l)
 
 
-def _dims(layout: str, x: Array) -> tp.Tuple[int, int, int, int]:
-    """(B, H, T, C) of an activation in either layout."""
-    if layout == "bhtc":
-        b, h, t, c = x.shape
-    else:
-        b, t, h, c = x.shape
-    return b, h, t, c
-
-
 def _flash_forward(
-    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int,
-    layout: str = "bhtc",
+    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int
 ) -> tp.Tuple[Array, Array]:
-    b, h, t, c = _dims(layout, q)
-    _, hkv, s, _ = _dims(layout, k)
+    b, h, t, c = q.shape
+    _, hkv, s, _ = k.shape
     assert s == t, "self-attention only (use decode path for caches)"
     groups = h // hkv
     bq, bk = _block_sizes(t, bq, bk, causal)
@@ -192,7 +156,6 @@ def _flash_forward(
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        layout=layout,
     )
     row_q = lambda b_, h_, iq, ik: iq  # noqa: E731
     row_k = lambda b_, h_, iq, ik: ik  # noqa: E731
@@ -202,16 +165,16 @@ def _flash_forward(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
-            _act_spec(layout, bq, c, row_q, q_head),
-            _act_spec(layout, bk, c, row_k, kv_head),
-            _act_spec(layout, bk, c, row_k, kv_head),
+            _act_spec(bq, c, row_q, q_head),
+            _act_spec(bk, c, row_k, kv_head),
+            _act_spec(bk, c, row_k, kv_head),
         ],
         out_specs=[
-            _act_spec(layout, bq, c, row_q, q_head),
+            _act_spec(bq, c, row_q, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
             jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -233,7 +196,7 @@ def _flash_forward(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int, layout: str,
+    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
@@ -245,10 +208,10 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = _read(layout, q_ref)
-        k = _read(layout, k_ref)
-        v = _read(layout, v_ref)
-        do = _read(layout, do_ref)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]  # [bq, 1] f32
         delta = delta_ref[0, 0]  # [bq, 1] f32
         s = jax.lax.dot_general(
@@ -275,13 +238,13 @@ def _bwd_dq_kernel(
 
     @pl.when(ik == last_k)
     def _finalize():
-        _write(layout, dq_ref, dq_acc[:].astype(dq_ref.dtype))
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nq: int, layout: str,
+    *, scale: float, causal: bool, bq: int, bk: int, nq: int,
 ):
     ik, iq = pl.program_id(2), pl.program_id(3)
 
@@ -294,10 +257,10 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        q = _read(layout, q_ref)  # [bq, C]
-        k = _read(layout, k_ref)  # [bk, C]
-        v = _read(layout, v_ref)
-        do = _read(layout, do_ref)  # [bq, C]
+        q = q_ref[0, 0]  # [bq, C]
+        k = k_ref[0, 0]  # [bk, C]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]  # [bq, C]
         lse = lse_ref[0, 0]  # [bq, 1]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -328,32 +291,29 @@ def _bwd_dkv_kernel(
 
     @pl.when(iq == nq - 1)
     def _finalize():
-        _write(layout, dk_ref, dk_acc[:].astype(dk_ref.dtype))
-        _write(layout, dv_ref, dv_acc[:].astype(dv_ref.dtype))
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_backward(
     q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
-    *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None,
-    layout: str = "bhtc",
+    *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None
 ) -> tp.Tuple[Array, Array, Array]:
-    b, h, t, c = _dims(layout, q)
-    hkv = _dims(layout, k)[1]
+    b, h, t, c = q.shape
+    hkv = k.shape[1]
     groups = h // hkv
     bq, bk = _block_sizes(t, bq, bk, causal)
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
 
     # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA; stored
-    # [B, H, T, 1] in BOTH layouts (tiny, consumed by the kernels only).
+    # [B, H, T, 1] (tiny, consumed by the kernels only).
     # When the caller also consumes lse (flash_attention_lse), its
     # cotangent folds in exactly here: dL/dz_ij = p_ij (dp_ij - delta_i
     # + dlse_i), since dlse_i/dz_ij = p_ij — so delta_eff = delta - dlse.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )
-    if layout == "bthc":
-        delta = jnp.transpose(delta, (0, 2, 1, 3))  # [B, H, T, 1]
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
@@ -367,19 +327,18 @@ def _flash_backward(
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            layout=layout,
         ),
         grid=(b, h, nq, nk),
         in_specs=[
-            _act_spec(layout, bq, c, row_q34, q_head),
-            _act_spec(layout, bk, c, row_k34, kv_head),
-            _act_spec(layout, bk, c, row_k34, kv_head),
-            _act_spec(layout, bq, c, row_q34, q_head),
+            _act_spec(bq, c, row_q34, q_head),
+            _act_spec(bk, c, row_k34, kv_head),
+            _act_spec(bk, c, row_k34, kv_head),
+            _act_spec(bq, c, row_q34, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
-        out_specs=_act_spec(layout, bq, c, row_q34, q_head),
-        out_shape=jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), q.dtype),
+        out_specs=_act_spec(bq, c, row_q34, q_head),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, c), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -390,24 +349,23 @@ def _flash_backward(
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            layout=layout,
         ),
         grid=(b, h, nk, nq),
         in_specs=[
-            _act_spec(layout, bq, c, row_q43, q_head),
-            _act_spec(layout, bk, c, row_k43, kv_head),
-            _act_spec(layout, bk, c, row_k43, kv_head),
-            _act_spec(layout, bq, c, row_q43, q_head),
+            _act_spec(bq, c, row_q43, q_head),
+            _act_spec(bk, c, row_k43, kv_head),
+            _act_spec(bk, c, row_k43, kv_head),
+            _act_spec(bq, c, row_q43, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
         ],
         out_specs=[
-            _act_spec(layout, bk, c, row_k43, q_head),
-            _act_spec(layout, bk, c, row_k43, q_head),
+            _act_spec(bk, c, row_k43, q_head),
+            _act_spec(bk, c, row_k43, q_head),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), k.dtype),
-            jax.ShapeDtypeStruct(_act_shape(layout, b, h, t, c), v.dtype),
+            jax.ShapeDtypeStruct((b, h, t, c), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, c), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, c), jnp.float32),
@@ -419,12 +377,8 @@ def _flash_backward(
     )(q, k, v, do, lse, delta)
 
     if groups > 1:
-        if layout == "bhtc":
-            dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
-            dv = dv_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(v.dtype)
-        else:
-            dk = dk_h.reshape(b, t, hkv, groups, c).sum(axis=3).astype(k.dtype)
-            dv = dv_h.reshape(b, t, hkv, groups, c).sum(axis=3).astype(v.dtype)
+        dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h, dv_h
     return dq, dk, dv
@@ -442,17 +396,16 @@ def flash_attention(
     causal: bool = True,
     block_q: tp.Optional[int] = None,
     block_k: tp.Optional[int] = None,
-    layout: str = "bhtc",
 ) -> Array:
     """Flash attention output only — delegates to flash_attention_lse (the
     dropped lse's cotangent instantiates to zeros, making the backward's
     ``delta - dlse`` fold a no-op), so there is a single VJP pair to
     maintain."""
-    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k, layout)
+    out, _ = flash_attention_lse(q, k, v, causal, block_q, block_k)
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_lse(
     q: Array,
     k: Array,
@@ -460,34 +413,28 @@ def flash_attention_lse(
     causal: bool = True,
     block_q: tp.Optional[int] = None,
     block_k: tp.Optional[int] = None,
-    layout: str = "bhtc",
 ) -> tp.Tuple[Array, Array]:
-    """Flash attention returning (out in ``layout``, lse [B,H,T]).
+    """Flash attention returning (out [B,H,T,C], lse [B,H,T]).
 
     The lse output is differentiable — its cotangent folds into the
     backward kernels as ``delta - dlse`` (see _flash_backward) — which is
     what lets ring attention (midgpt_tpu.parallel.ring) run this kernel
     per hop and still autodiff through the streaming LSE merge."""
-    out, lse = _flash_forward(
-        q, k, v, causal=causal, bq=block_q, bk=block_k, layout=layout
-    )
+    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
     return out, lse[..., 0]
 
 
-def _lse_vjp_fwd(q, k, v, causal, block_q, block_k, layout):
-    out, lse = _flash_forward(
-        q, k, v, causal=causal, bq=block_q, bk=block_k, layout=layout
-    )
+def _lse_vjp_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
     return (out, lse[..., 0]), (q, k, v, out, lse)
 
 
-def _lse_vjp_bwd(causal, block_q, block_k, layout, residuals, cts):
+def _lse_vjp_bwd(causal, block_q, block_k, residuals, cts):
     q, k, v, out, lse = residuals
     do, dlse = cts
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, do,
         causal=causal, bq=block_q, bk=block_k, dlse=dlse[..., None],
-        layout=layout,
     )
     return dq, dk, dv
 

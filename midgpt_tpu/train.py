@@ -361,7 +361,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # fingerprint covers only fields that change the math/parameters —
         # runtime implementation knobs (kernel choice, remat, unroll) may vary
         # freely between save and resume
-        _impl_knobs = ("attn_impl", "attn_layout", "norm_impl", "remat", "scan_unroll")
+        _impl_knobs = ("attn_impl", "norm_impl", "remat", "scan_unroll")
         fingerprint = config_fingerprint(
             {k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs}
         )

@@ -119,38 +119,3 @@ def test_flash_lse_outputs_and_grads():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
         )
-
-
-@pytest.mark.parametrize("causal", [True, False])
-def test_flash_bthc_layout_matches_bhtc(causal):
-    """The transpose-free [B,T,H,C] layout must produce the transposed
-    result of the [B,H,T,C] layout — fwd and grads."""
-    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 2, 4, 2, 256, 32)
-    out_ref = flash_mod.flash_attention(q, k, v, causal, 128, 128)
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    out_t = flash_mod.flash_attention(qt, kt, vt, causal, 128, 128, "bthc")
-    np.testing.assert_allclose(
-        np.asarray(jnp.transpose(out_t, (0, 2, 1, 3))),
-        np.asarray(out_ref),
-        atol=2e-5,
-    )
-
-    def loss_bhtc(q, k, v):
-        return jnp.sum(flash_mod.flash_attention(q, k, v, causal, 128, 128) ** 2)
-
-    def loss_bthc(qt, kt, vt):
-        return jnp.sum(
-            flash_mod.flash_attention(qt, kt, vt, causal, 128, 128, "bthc") ** 2
-        )
-
-    g_ref = jax.grad(loss_bhtc, argnums=(0, 1, 2))(q, k, v)
-    g_t = jax.grad(loss_bthc, argnums=(0, 1, 2))(qt, kt, vt)
-    for a, b, name in zip(g_ref, g_t, "qkv"):
-        np.testing.assert_allclose(
-            np.asarray(a),
-            np.asarray(jnp.transpose(b, (0, 2, 1, 3))),
-            atol=5e-4,
-            err_msg=f"d{name}",
-        )
