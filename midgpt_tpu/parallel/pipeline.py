@@ -37,6 +37,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 Array = jax.Array
 
+
+def _to_varying(x: Array, axis: str) -> Array:
+    """Promote ``x`` to VARYING along the mesh axis. ``jax.lax.pcast``
+    replaced ``pvary`` in newer JAX; fall back so older pins keep working."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
 StageFn = tp.Callable[[tp.Any, Array], Array]
 """(stage_params, activation [Bm, ...]) -> activation [Bm, ...]; applies
 one stage's worth of layers (e.g. a lax.scan over the local layer chunk)."""
@@ -73,11 +81,11 @@ def pipeline_forward(
         # params_local leaves: [L/S, ...] (shard_map strips the stage dim)
         # x_local: [M, Bm, ...] (replicated across the pipeline axis).
         # Everything entering the tick carry is promoted to pipeline-VARYING
-        # (pvary): the carry mixes per-stage values (ppermute output, banked
+        # (pcast to='varying'): the carry mixes per-stage values (ppermute output, banked
         # activations) with broadcast inputs, and an invariant/varying mix in
         # a scan carry is unsound — it surfaced as an XLA miscompile
         # ("Invalid binary instruction opcode copy") under check_vma=False.
-        x_local = jax.lax.pvary(x_local, axis)
+        x_local = _to_varying(x_local, axis)
         s_idx = jax.lax.axis_index(axis)
         n_ticks = m + n_stages - 1
         zero_act = jnp.zeros_like(x_local[0])
@@ -114,7 +122,7 @@ def pipeline_forward(
             )
             return (sent, outputs), None
 
-        outputs0 = jax.lax.pvary(
+        outputs0 = _to_varying(
             jnp.zeros((m,) + x_local.shape[1:], x_local.dtype), axis
         )
         (_, outputs), _ = jax.lax.scan(
