@@ -29,7 +29,10 @@ import numpy as np
 BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
 
 
-def _run_config(remat: str, batch: int, base: str = "openwebtext", n_layer=None):
+def _run_config(
+    remat: str, batch: int, base: str = "openwebtext", n_layer=None,
+    loss_chunk: int = 256,
+):
     """Build state + step for one candidate config; returns a timing
     closure. Raises on compile/alloc failure (caller falls back)."""
     from jax.sharding import PartitionSpec as P
@@ -59,8 +62,10 @@ def _run_config(remat: str, batch: int, base: str = "openwebtext", n_layer=None)
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
         # head+xent computed T-chunk-wise: the [B,T,V] f32 logits (3.3 GB
         # at this config) never materialize, which is what makes the
-        # remat='none' rung fit in HBM
-        loss_chunk=256,
+        # remat='none' rung fit in HBM; unrolled chunk loop measured
+        # slightly faster than the while-loop scan (PERF.md r2 sweep)
+        loss_chunk=loss_chunk,
+        loss_chunk_unroll=True,
     )
 
     mesh = create_mesh(cfg.mesh)
@@ -121,7 +126,8 @@ def main() -> None:
     for xl_layers, xl_batch in ((6, 16 * n_dev), (8, 8 * n_dev), (6, 8 * n_dev)):
         try:
             xcfg, xstate, xchain = _run_config(
-                "none", xl_batch, base="openwebtext_xl", n_layer=xl_layers
+                "none", xl_batch, base="openwebtext_xl", n_layer=xl_layers,
+                loss_chunk=512,
             )
             _, xstate = xchain(xstate, 1)  # compile + 1 step
             xtps, xstep_ms, xstate = _measure(xcfg, xstate, xchain)

@@ -137,6 +137,10 @@ class ExperimentConfig:
     # logits never materialize. None = dense loss (reference parity path);
     # ignored (dense used) when the sequence axis is sharded.
     loss_chunk: tp.Optional[int] = None
+    # unroll the chunk scan: kills the while-loop overhead (carried [D,V]
+    # dW re-read/written per backward iteration) while keeping per-chunk
+    # logits checkpointed — measured win on the flagship shape (PERF.md r2)
+    loss_chunk_unroll: bool = False
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     use_wandb: bool = False  # wandb.init on proc 0 (parity: launch.py:68)
     debug: bool = False

@@ -36,9 +36,17 @@ def chunked_softmax_xent(
     targets: Array,  # [B, T] int
     *,
     chunk_t: int = 128,
+    unroll: tp.Union[bool, int] = False,
 ) -> Array:
     """Mean cross-entropy over all B*T tokens, identical math to
-    ``softmax_cross_entropy_with_integer_labels(h @ head_w -> f32, y)``."""
+    ``softmax_cross_entropy_with_integer_labels(h @ head_w -> f32, y)``.
+
+    ``unroll`` is forwarded to the chunk ``lax.scan``: profiling the
+    flagship shape (PERF.md r2) showed the rolled loop's while overhead —
+    the carried [D, V] dW buffer re-read/written every backward iteration
+    and the serialized chunk matmuls — costs more than the [B, tc, V]
+    working set saves; unrolling keeps the memory bound (each chunk's
+    logits are still checkpointed) while letting XLA overlap chunks."""
     b, t, d = h.shape
     assert t % chunk_t == 0, f"T={t} not divisible by chunk_t={chunk_t}"
     nc = t // chunk_t
@@ -71,5 +79,7 @@ def chunked_softmax_xent(
             z_y = jnp.take_along_axis(z, y_i[..., None], axis=-1)[..., 0]
         return acc + jnp.sum(lse - z_y), None
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h_c, y_c))
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((), jnp.float32), (h_c, y_c), unroll=unroll
+    )
     return total / (b * t)

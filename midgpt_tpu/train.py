@@ -93,6 +93,7 @@ def loss_fn(
     key: tp.Optional[Array],
     deterministic: bool,
     loss_chunk: tp.Optional[int] = None,
+    loss_chunk_unroll: tp.Union[bool, int] = False,
     pp_mesh=None,
     pp_microbatches: int = 0,
 ) -> Array:
@@ -115,7 +116,8 @@ def loss_fn(
         from midgpt_tpu.ops.loss import chunked_softmax_xent
 
         return chunked_softmax_xent(
-            h, model.head_weight(h.dtype), y, chunk_t=loss_chunk
+            h, model.head_weight(h.dtype), y, chunk_t=loss_chunk,
+            unroll=loss_chunk_unroll,
         )
     from midgpt_tpu.parallel.sharding import shard_act
 
@@ -177,6 +179,7 @@ def make_train_step(
                 k if has_dropout else None,
                 not has_dropout,
                 loss_chunk,
+                cfg.loss_chunk_unroll,
                 pp_mesh,
                 cfg.mesh.pp_microbatches,
             )
@@ -193,6 +196,7 @@ def make_train_step(
                 keys[0] if has_dropout else None,
                 not has_dropout,
                 loss_chunk,
+                cfg.loss_chunk_unroll,
                 pp_mesh,
                 cfg.mesh.pp_microbatches,
             )
@@ -233,7 +237,7 @@ def make_eval_step(cfg: ExperimentConfig, mesh):
             params_c = cast_floating(params, compute_dtype)
             return loss_fn(
                 params_c, x, y, None, True, loss_chunk,
-                pp_mesh, cfg.mesh.pp_microbatches,
+                cfg.loss_chunk_unroll, pp_mesh, cfg.mesh.pp_microbatches,
             )
 
     return jax.jit(eval_fn)
