@@ -196,6 +196,7 @@ def main() -> None:
                         "model_flops_per_token": flops_per_token(cfg.model),
                     }
                 )
+            record.pop("gpt2s_error", None)  # a later rung succeeded
             del state, chain
             gc.collect()
             break

@@ -73,16 +73,10 @@ def main() -> None:
     cfg = dataclasses.replace(cfg, rundir=rundir, debug=args.debug or cfg.debug)
 
     if jax.process_index() == 0:
-        if rundir.startswith("gs://"):
-            import gcsfs
+        from midgpt_tpu.utils.fsio import open_path
 
-            fs = gcsfs.GCSFileSystem()
-            with fs.open(os.path.join(rundir, "config.json"), "w") as f:
-                f.write(to_json(cfg))
-        else:
-            os.makedirs(rundir, exist_ok=True)
-            with open(os.path.join(rundir, "config.json"), "w") as f:
-                f.write(to_json(cfg))
+        with open_path(os.path.join(rundir, "config.json"), "w") as f:
+            f.write(to_json(cfg))
         print(to_json(cfg))
 
     if args.multihost:

@@ -421,7 +421,10 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
 
         loss = None
         for itr in pbar:
-            if itr % cfg.eval_interval == 0 and itr > first_step:
+            # evaluate whenever the interval hits — including step 0 and the
+            # first step after a resume, so the loss series always has a
+            # pre-training / post-restore point (parity: train.py:195-201)
+            if itr % cfg.eval_interval == 0 or itr == first_step:
                 n_eval = 1 if cfg.debug else cfg.eval_batches
                 train_loss = evaluate(
                     eval_step, state.params, train_eval_loader, mesh, n_eval, itr

@@ -1,0 +1,34 @@
+"""Uniform local/GCS file access.
+
+The reference hand-rolls a gs://-vs-local branch at every site that touches
+a rundir file (/root/reference/launch.py:43-53, sample.py:39-46,
+launch.py:60-67 for the wandb id). We keep one helper instead; every
+rundir-file consumer (launch.py, sample.py, utils/metrics.py) routes
+through it, so auth/retry changes happen in one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def open_path(path: str, mode: str = "r"):
+    """open() that understands gs:// (via gcsfs). Creates parent dirs for
+    local writes; gcsfs handles bucket "dirs" implicitly."""
+    if path.startswith("gs://"):
+        import gcsfs
+
+        return gcsfs.GCSFileSystem().open(path, mode)
+    if "w" in mode or "a" in mode:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    return open(path, mode)
+
+
+def path_exists(path: str) -> bool:
+    if path.startswith("gs://"):
+        import gcsfs
+
+        return gcsfs.GCSFileSystem().exists(path)
+    return os.path.exists(path)

@@ -69,25 +69,15 @@ def _load_or_create_wandb_id(rundir: str, wandb_mod) -> tp.Optional[str]:
     isn't a writable local path (wandb then picks its own id)."""
     if not rundir:
         return None
+    from midgpt_tpu.utils.fsio import open_path, path_exists
+
     path = os.path.join(rundir, "wandb_id.txt")
     try:
-        if rundir.startswith("gs://"):
-            import gcsfs
-
-            fs = gcsfs.GCSFileSystem()
-            if fs.exists(path):
-                with fs.open(path, "r") as f:
-                    return f.read().strip()
-            run_id = wandb_mod.util.generate_id()
-            with fs.open(path, "w") as f:
-                f.write(run_id)
-            return run_id
-        if os.path.exists(path):
-            with open(path) as f:
+        if path_exists(path):
+            with open_path(path) as f:
                 return f.read().strip()
-        os.makedirs(rundir, exist_ok=True)
         run_id = wandb_mod.util.generate_id()
-        with open(path, "w") as f:
+        with open_path(path, "w") as f:
             f.write(run_id)
         return run_id
     except Exception:

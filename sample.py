@@ -16,6 +16,17 @@ import os
 import pickle
 
 
+def load_run_config(ckpt_dir: str):
+    """Read <ckpt_dir>/config.json, via gcsfs for gs:// rundirs (parity:
+    /root/reference/sample.py:39-46 — the reference switches to gcsfs when
+    the dir is a bucket path; Checkpointer already handles gs:// itself)."""
+    from midgpt_tpu.config import from_dict
+    from midgpt_tpu.utils.fsio import open_path
+
+    with open_path(os.path.join(ckpt_dir, "config.json")) as f:
+        return from_dict(json.load(f))
+
+
 def get_tokenizer(data_dir: str):
     meta_path = os.path.join(data_dir, "meta.pkl") if data_dir else ""
     if meta_path and os.path.exists(meta_path):
@@ -58,12 +69,10 @@ def main() -> None:
     import numpy as np
 
     from midgpt_tpu.checkpoint import Checkpointer
-    from midgpt_tpu.config import from_dict
     from midgpt_tpu.pytree import cast_floating
     from midgpt_tpu.sampling import make_sampler
 
-    with open(os.path.join(args.ckpt_dir, "config.json")) as f:
-        cfg = from_dict(json.load(f))
+    cfg = load_run_config(args.ckpt_dir)
 
     # params-only restore: checkpoints store params / opt_state as separate
     # items, so sampling never materializes Adam moments (the reference

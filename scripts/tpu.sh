@@ -14,6 +14,8 @@
 #   TPU_TYPE      accelerator type, e.g. v5p-128 (create)
 #   TPU_VERSION   runtime version, e.g. v2-alpha-tpuv5 (create)
 #   TPU_REPO_DIR  remote checkout path (default: ~/midgpt_tpu)
+#   TPU_DATA_DISK dataset persistent disk to attach+mount at
+#                 /mnt/disks/persist during `setup` (optional)
 set -euo pipefail
 
 REPO_DIR_REMOTE="${TPU_REPO_DIR:-\$HOME/midgpt_tpu}"
@@ -71,8 +73,31 @@ case "$cmd" in
     gc delete "$TPU_NAME" --quiet
     ;;
   setup)
-    # install deps on every host (parity: setup.sh:8-10)
+    # install deps on every host (parity: setup.sh:8-10), then attach and
+    # mount the dataset persistent disk when TPU_DATA_DISK is set (parity:
+    # setup.sh:13-19 — the openwebtext configs point at /mnt/disks/persist)
     all_hosts "pip install -q -U 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html optax orbax-checkpoint tqdm wandb gcsfs tiktoken"
+    if [[ -n "${TPU_DATA_DISK:-}" ]]; then
+      # idempotent: re-running setup must not die on "already attached"
+      if ! gcloud alpha compute tpus tpu-vm attach-disk "$TPU_NAME" \
+          --project "$TPU_PROJECT" --zone "$TPU_ZONE" \
+          --disk "$TPU_DATA_DISK" --mode=read-only 2>/tmp/attach_err; then
+        grep -qi "already attached" /tmp/attach_err \
+          || { cat /tmp/attach_err >&2; exit 1; }
+      fi
+      # find the device by disk name (not /dev/sdb — enumeration order is
+      # not stable); read-only-attached ext4 needs '-o ro,noload'. Failure
+      # must surface: a silent no-data mount strands the openwebtext runs.
+      all_hosts "set -e; \
+        dev=\$(readlink -f /dev/disk/by-id/google-${TPU_DATA_DISK} 2>/dev/null || true); \
+        [ -b \"\$dev\" ] || dev=/dev/sdb; \
+        sudo mkdir -p /mnt/disks/persist; \
+        mountpoint -q /mnt/disks/persist || \
+          sudo mount -o ro,noload \"\$dev\" /mnt/disks/persist; \
+        ls /mnt/disks/persist >/dev/null"
+    else
+      echo "note: TPU_DATA_DISK not set; skipping dataset-disk attach/mount" >&2
+    fi
     ;;
   copy)
     # rsync the local checkout to every host (parity: tpu_commands.sh copy)

@@ -6,6 +6,7 @@ checkpointing (parity target: the reference's multihost mechanisms,
 src/sharding.py:33-42 per-host batch assembly + src/train.py:127-225)."""
 
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -148,10 +149,16 @@ def test_two_process_data_feed(tmp_path):
     outs = _run_workers(worker, lambda attempt: [token_path])
     for i, out in enumerate(outs):
         assert f"OK proc={i}" in out, out
-    # both processes computed the same global sum
-    t0 = [l for l in outs[0].splitlines() if l.startswith("OK")][0].split("total=")[1]
-    t1 = [l for l in outs[1].splitlines() if l.startswith("OK")][0].split("total=")[1]
-    assert t0 == t1
+    # both processes computed the same global sum; parse the numeric token
+    # only — Gloo banners can interleave onto the same stdout line
+    # (observed flake, VERDICT r2 Weak #6)
+    def _total(out: str) -> int:
+        line = [l for l in out.splitlines() if l.startswith("OK")][0]
+        m = re.search(r"total=(\d+)", line)
+        assert m, line
+        return int(m.group(1))
+
+    assert _total(outs[0]) == _total(outs[1])
 
 
 @pytest.mark.slow
