@@ -92,6 +92,8 @@ class Attention:
         adrop_key, pdrop_key = (
             jax.random.split(key) if key is not None else (None, None)
         )
+        if self._use_fused(impl, t, deterministic) and not return_kv:
+            return self._fused_call(x, sin, cos, pdrop_key, deterministic)
         with jax.named_scope("attention"):
             qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
             q = qkv[..., : h * c].reshape(b, t, h, c)
@@ -145,6 +147,63 @@ class Attention:
                 return out, (k, v)
             return out
 
+
+    def _use_fused(self, impl: str, t: int, deterministic: bool) -> bool:
+        """Route to the projection-natural fused kernel (ops/fused_attn):
+        QK-LN + RoPE + flash in one Pallas call, no [B,H,T,C] intermediates.
+        impl="fused" forces it (tests, via the interpret fixture); "auto"
+        takes it on TPU under the same conditions flash requires."""
+        from midgpt_tpu.ops.fused_attn import supported
+
+        if impl not in ("fused", "auto") or self.q_norm is None:
+            return False
+        shape_ok = (
+            supported(self.n_head, self.n_kv_head, self.head_dim())
+            and t >= 128
+            and t % 128 == 0
+            and (self.dropout_rate == 0.0 or deterministic)
+        )
+        if impl == "fused":
+            assert shape_ok, (
+                "attn_impl='fused' requires qk-norm, T % 128 == 0, no "
+                "attention dropout, and a supported head shape "
+                "(C % 128 == 0, or C == 64 with MHA)"
+            )
+            return True
+        from midgpt_tpu.utils.platform import is_tpu_backend
+
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            return False  # TP shards heads; packed-qkv path keeps lanes whole
+        return shape_ok and is_tpu_backend()
+
+    def head_dim(self) -> int:
+        # static: wo is [H*C, D]
+        return self.wo.weight.shape[0] // self.n_head
+
+    def _fused_call(self, x, sin, cos, pdrop_key, deterministic):
+        from midgpt_tpu.models.layers import _duplicate_interleaved
+        from midgpt_tpu.ops.fused_attn import fused_attention_qkv
+
+        b, t, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = self.head_dim()
+        with jax.named_scope("fused_attention"):
+            qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
+            # packed entry: the kernel reads q/k/v via lane-offset index
+            # maps — no slice copies, no pad+add VJP. The lane dim stays
+            # unsharded here (TP head-sharding would split the packed
+            # q|k|v regions unevenly); TP meshes use the unfused path.
+            qkv = shard_act(qkv, "batch", "seq", None)
+            sin_full = _duplicate_interleaved(jnp.asarray(sin, jnp.float32))
+            cos_full = _duplicate_interleaved(jnp.asarray(cos, jnp.float32))
+            out = fused_attention_qkv(
+                qkv, self.q_norm.weight, self.k_norm.weight,
+                sin_full, cos_full, h, hkv,
+            )
+            out = self.wo(out)
+            out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
+            return shard_act(out, "batch", "seq", "embed")
 
     def decode(
         self,
@@ -513,7 +572,7 @@ def prefill(
     # ring needs a live mesh, and an explicit 'flash' may not divide an
     # arbitrary prompt length — 'auto' keeps the flash fast path for
     # aligned prompts and falls back to naive otherwise
-    impl = "auto" if cfg.attn_impl in ("ring", "flash") else cfg.attn_impl
+    impl = "auto" if cfg.attn_impl in ("ring", "flash", "fused") else cfg.attn_impl
 
     h, (ks, vs) = model.hidden(
         tokens, deterministic=True, attn_impl=impl, return_kv=True

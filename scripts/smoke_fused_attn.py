@@ -1,0 +1,155 @@
+"""On-chip smoke: projection-natural fused attention (QK-LN+RoPE+flash).
+
+Runs on the REAL TPU (not interpret mode — r2's transpose-free post-mortem
+proved Mosaic can reject layouts the interpreter accepts, PERF.md):
+  1. fwd + bwd parity vs the unfused jnp oracle at the 124M MHA shape
+     (C=64 head-pair mode) and the llama GQA shape (C=128).
+  2. microbench fused vs the current unfused path (LN+rope+transposes
+     around ops.flash), fwd and fwd+bwd.
+
+Usage: PYTHONPATH=. python scripts/smoke_fused_attn.py [--quick]
+Writes artifacts/smoke_fused_attn.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _chain_time(fn, args, n=20):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn_j(*args)
+    leaves = jax.tree.leaves(out)
+    _ = float(jnp.sum(leaves[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def _setup(b, t, h, hkv, c, dtype, seed=0):
+    from midgpt_tpu.models.layers import rope_tables
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    q = jax.random.normal(ks[0], (b, t, h * c), dtype)
+    k = jax.random.normal(ks[1], (b, t, hkv * c), dtype)
+    v = jax.random.normal(ks[2], (b, t, hkv * c), dtype)
+    wq = 1.0 + 0.1 * jax.random.normal(ks[3], (c,), jnp.float32)
+    wk = 1.0 + 0.1 * jax.random.normal(ks[4], (c,), jnp.float32)
+    sin_h, cos_h = rope_tables(c, t)
+    sin = jnp.asarray(np.repeat(sin_h, 2, axis=-1), jnp.float32)
+    cos = jnp.asarray(np.repeat(cos_h, 2, axis=-1), jnp.float32)
+    return q, k, v, wq, wk, sin, cos
+
+
+def parity_case(name, b, t, h, hkv, c, record):
+    from midgpt_tpu.ops.fused_attn import (
+        fused_attention,
+        fused_attention_reference,
+    )
+
+    q, k, v, wq, wk, sin, cos = _setup(b, t, h, hkv, c, jnp.bfloat16)
+    w_out = jax.random.normal(jax.random.PRNGKey(9), (h * c,), jnp.float32)
+
+    def loss_fused(q, k, v, wq, wk):
+        out = fused_attention(q, k, v, wq, wk, sin, cos, h, hkv)
+        return jnp.sum(out.astype(jnp.float32) * w_out)
+
+    def loss_ref(q, k, v, wq, wk):
+        out = fused_attention_reference(q, k, v, wq, wk, sin, cos, h, hkv)
+        return jnp.sum(out.astype(jnp.float32) * w_out)
+
+    out = jax.jit(
+        lambda *a: fused_attention(*a, sin, cos, h, hkv)
+    )(q, k, v, wq, wk)
+    ref = jax.jit(
+        lambda *a: fused_attention_reference(*a, sin, cos, h, hkv)
+    )(q, k, v, wq, wk)
+    fwd_err = float(
+        jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    )
+
+    gf = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4)))(q, k, v, wq, wk)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4)))(q, k, v, wq, wk)
+    gerrs = {}
+    for gname, a_, b_ in zip(["dq", "dk", "dv", "dwq", "dwk"], gf, gr):
+        denom = float(jnp.max(jnp.abs(b_.astype(jnp.float32)))) + 1e-6
+        gerrs[gname] = float(
+            jnp.max(jnp.abs(a_.astype(jnp.float32) - b_.astype(jnp.float32)))
+        ) / denom
+    record[name] = {"fwd_max_abs_err": fwd_err, "grad_max_rel_err": gerrs}
+    ok = fwd_err < 0.1 and all(e < 0.05 for e in gerrs.values())
+    print(f"[{name}] fwd_err={fwd_err:.4f} grad_rel_errs={gerrs} -> "
+          f"{'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def bench_case(name, b, t, h, hkv, c, record):
+    from midgpt_tpu.models.layers import LayerNorm, apply_rotary, rope_tables
+    from midgpt_tpu.ops.flash import flash_attention
+    from midgpt_tpu.ops.fused_attn import fused_attention
+
+    q, k, v, wq, wk, sin, cos = _setup(b, t, h, hkv, c, jnp.bfloat16)
+    sin_h, cos_h = rope_tables(c, t)
+    qn = LayerNorm(weight=wq)
+    kn = LayerNorm(weight=wk)
+
+    def unfused(q, k, v, qn, kn):
+        qh = qn(q.reshape(b, t, h, c))
+        kh = kn(k.reshape(b, t, hkv, c))
+        vh = v.reshape(b, t, hkv, c)
+        qh = jnp.transpose(qh, (0, 2, 1, 3))
+        kh = jnp.transpose(kh, (0, 2, 1, 3))
+        vh = jnp.transpose(vh, (0, 2, 1, 3))
+        qh = apply_rotary(qh, sin_h, cos_h)
+        kh = apply_rotary(kh, sin_h, cos_h)
+        o = flash_attention(qh, kh, vh)
+        return jnp.transpose(o, (0, 2, 1, 3)).reshape(b, t, h * c)
+
+    def fused(q, k, v, wq, wk):
+        return fused_attention(q, k, v, wq, wk, sin, cos, h, hkv)
+
+    r = {}
+    r["unfused_fwd_ms"] = _chain_time(unfused, (q, k, v, qn, kn))
+    r["fused_fwd_ms"] = _chain_time(fused, (q, k, v, wq, wk))
+
+    def g(fn, nargs):
+        def loss(*a):
+            return jnp.sum(fn(*a).astype(jnp.float32))
+
+        return jax.grad(loss, argnums=tuple(range(nargs)))
+
+    r["unfused_fb_ms"] = _chain_time(g(unfused, 3), (q, k, v, qn, kn))
+    r["fused_fb_ms"] = _chain_time(g(fused, 5), (q, k, v, wq, wk))
+    record[name + "_bench"] = r
+    print(f"[{name}] unfused fwd {r['unfused_fwd_ms']:.2f} / fused fwd "
+          f"{r['fused_fwd_ms']:.2f} ms ; unfused f+b {r['unfused_fb_ms']:.2f}"
+          f" / fused f+b {r['fused_fb_ms']:.2f} ms")
+
+
+def main():
+    quick = "--quick" in sys.argv
+    record = {"device": jax.devices()[0].device_kind}
+    ok = parity_case("gpt2s_mha_c64", 4, 1024, 12, 12, 64, record)
+    ok &= parity_case("llama_gqa_c128", 2, 2048, 8, 2, 128, record)
+    if not quick:
+        bench_case("gpt2s_shape", 16, 1024, 12, 12, 64, record)
+        bench_case("llama_shape", 4, 2048, 16, 4, 128, record)
+    record["ok"] = bool(ok)
+    os.makedirs("artifacts", exist_ok=True)
+    with open("artifacts/smoke_fused_attn.json", "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

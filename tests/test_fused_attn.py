@@ -1,0 +1,167 @@
+"""Projection-natural fused attention (QK-LN + RoPE + flash) parity, via the
+Pallas CPU interpreter. Real-TPU parity is exercised by
+scripts/smoke_fused_attn.py (committed artifact) and bench.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(pallas_interpret):
+    yield
+
+
+def _setup(b, t, h, hkv, c, dtype=jnp.float32, seed=0):
+    from midgpt_tpu.models.layers import rope_tables
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    q = jax.random.normal(ks[0], (b, t, h * c), dtype)
+    k = jax.random.normal(ks[1], (b, t, hkv * c), dtype)
+    v = jax.random.normal(ks[2], (b, t, hkv * c), dtype)
+    wq = 1.0 + 0.1 * jax.random.normal(ks[3], (c,), jnp.float32)
+    wk = 1.0 + 0.1 * jax.random.normal(ks[4], (c,), jnp.float32)
+    sin_h, cos_h = rope_tables(c, t)
+    # duplicated-interleaved [T, C] tables (what the kernel consumes)
+    sin = jnp.asarray(np.repeat(sin_h, 2, axis=-1), jnp.float32)
+    cos = jnp.asarray(np.repeat(cos_h, 2, axis=-1), jnp.float32)
+    return q, k, v, wq, wk, sin, cos
+
+
+@pytest.mark.parametrize(
+    "h,hkv,c,t,blk",
+    [
+        (4, 4, 64, 256, 128),  # MHA C=64 -> two heads per 128-lane block
+        (4, 2, 128, 256, 128),  # GQA C=128 -> one head per block
+        (2, 2, 64, 256, 256),  # single k block (nk == 1)
+    ],
+)
+def test_fused_forward_parity(h, hkv, c, t, blk):
+    from midgpt_tpu.ops.fused_attn import (
+        fused_attention,
+        fused_attention_reference,
+        supported,
+    )
+
+    assert supported(h, hkv, c)
+    q, k, v, wq, wk, sin, cos = _setup(2, t, h, hkv, c)
+    out = fused_attention(
+        q, k, v, wq, wk, sin, cos, h, hkv, True, blk, blk
+    )
+    ref = fused_attention_reference(q, k, v, wq, wk, sin, cos, h, hkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "h,hkv,c,t,blk",
+    [
+        (4, 4, 64, 256, 128),
+        (4, 2, 128, 256, 128),
+    ],
+)
+def test_fused_grad_parity(h, hkv, c, t, blk):
+    from midgpt_tpu.ops.fused_attn import (
+        fused_attention,
+        fused_attention_reference,
+    )
+
+    q, k, v, wq, wk, sin, cos = _setup(2, t, h, hkv, c, seed=1)
+    w_out = jax.random.normal(jax.random.PRNGKey(9), (h * c,), jnp.float32)
+
+    def loss_fused(q, k, v, wq, wk):
+        out = fused_attention(q, k, v, wq, wk, sin, cos, h, hkv, True, blk, blk)
+        return jnp.sum(out * w_out)
+
+    def loss_ref(q, k, v, wq, wk):
+        out = fused_attention_reference(q, k, v, wq, wk, sin, cos, h, hkv)
+        return jnp.sum(out * w_out)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(q, k, v, wq, wk)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q, k, v, wq, wk)
+    for name, a, b in zip(["dq", "dk", "dv", "dwq", "dwk"], gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4, err_msg=name
+        )
+
+
+def test_supported_matrix():
+    from midgpt_tpu.ops.fused_attn import supported
+
+    assert supported(12, 12, 64)  # 124M MHA
+    assert supported(32, 8, 128)  # llama GQA
+    assert not supported(12, 6, 64)  # GQA at C=64: pair breaks kv mapping
+    assert not supported(11, 11, 64)  # odd head count can't pair
+    assert not supported(12, 12, 96)  # non-128, non-64 head dim
+
+
+def test_model_fused_matches_naive():
+    """GPT forward+grad with attn_impl='fused' vs 'naive' — the integration
+    point in models/gpt.py Attention._fused_call."""
+    import dataclasses
+
+    from midgpt_tpu.config import ModelConfig
+    from midgpt_tpu.models.gpt import GPT
+
+    cfg = ModelConfig(
+        block_size=128, vocab_size=96, n_layer=2, n_head=4, n_embd=256,
+        dropout=0.0, attn_impl="naive", remat="none", qk_norm=True,
+    )
+    model = GPT.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 96)
+
+    logits_naive = model(tokens)
+    model_fused = dataclasses.replace(
+        model, config=dataclasses.replace(cfg, attn_impl="fused")
+    )
+    logits_fused = model_fused(tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits_fused), np.asarray(logits_naive), atol=2e-4, rtol=1e-4
+    )
+
+    def loss(m, toks):
+        lg = m(toks)
+        return jnp.mean((lg - jax.lax.stop_gradient(lg) + 1.0) ** 2) + jnp.mean(
+            lg**2
+        )
+
+    g_naive = jax.grad(loss)(model, tokens)
+    g_fused = jax.grad(loss)(model_fused, tokens)
+    flat_n = jax.tree.leaves(g_naive)
+    flat_f = jax.tree.leaves(g_fused)
+    for a, b in zip(flat_f, flat_n):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3
+        )
+
+
+@pytest.mark.parametrize("h,hkv,c", [(4, 4, 64), (4, 2, 128)])
+def test_packed_qkv_matches_split(h, hkv, c):
+    """fused_attention_qkv (lane-offset reads from the packed projection)
+    must equal the split-input entry, values and grads."""
+    from midgpt_tpu.ops.fused_attn import fused_attention, fused_attention_qkv
+
+    t = 256
+    q, k, v, wq, wk, sin, cos = _setup(2, t, h, hkv, c, seed=3)
+    qkv = jnp.concatenate([q, k, v], axis=-1)
+
+    out_split = fused_attention(q, k, v, wq, wk, sin, cos, h, hkv)
+    out_packed = fused_attention_qkv(qkv, wq, wk, sin, cos, h, hkv)
+    np.testing.assert_allclose(
+        np.asarray(out_packed), np.asarray(out_split), atol=1e-6
+    )
+
+    w_out = jax.random.normal(jax.random.PRNGKey(7), (h * c,), jnp.float32)
+
+    def loss_packed(qkv, wq, wk):
+        return jnp.sum(fused_attention_qkv(qkv, wq, wk, sin, cos, h, hkv) * w_out)
+
+    def loss_split(q, k, v, wq, wk):
+        return jnp.sum(fused_attention(q, k, v, wq, wk, sin, cos, h, hkv) * w_out)
+
+    gp = jax.grad(loss_packed, argnums=(0, 1, 2))(qkv, wq, wk)
+    gs = jax.grad(loss_split, argnums=(0, 1, 2, 3, 4))(q, k, v, wq, wk)
+    dqkv_split = jnp.concatenate(gs[:3], axis=-1)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(dqkv_split), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gs[3]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gp[2]), np.asarray(gs[4]), atol=1e-6)
