@@ -206,6 +206,39 @@ def main() -> None:
             cfg = state = chain = None
             gc.collect()
 
+    # --- auxiliary rung: llama family (GQA + SwiGLU, C=128, T=2048) ------
+    # depth-scaled like the XL headline: the 7B per-layer compute shape
+    # (D=4096, H=32/Hkv=8, SwiGLU) at the depth that fits one chip with
+    # f32 params + Adam state (~770M params at L=2 incl. the 50304 embed)
+    for ll_layers, ll_batch in ((2, 8 * n_dev), (2, 4 * n_dev)):
+        try:
+            lcfg, lstate, lchain = _run_config(
+                "none", ll_batch, base="llama_7b", n_layer=ll_layers,
+                loss_chunk=512,
+            )
+            _, lstate = lchain(lstate, 1)
+            ltps, lstep_ms, lstate = _measure(lcfg, lstate, lchain)
+            lmfu = mfu(ltps, lcfg.model, n_dev)
+            record.update(
+                {
+                    "llama_metric": f"llama_7b_family_L{ll_layers}_train_mfu",
+                    "llama_mfu": round(lmfu, 4),
+                    "llama_vs_baseline": round(lmfu / BASELINE_MFU, 4),
+                    "llama_tokens_per_sec_per_chip": round(ltps / n_dev, 1),
+                    "llama_step_ms": round(lstep_ms, 1),
+                    "llama_batch_per_chip": lcfg.batch_size // n_dev,
+                }
+            )
+            record.pop("llama_error", None)
+            del lstate, lchain
+            gc.collect()
+            break
+        except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
+            exc.__traceback__ = None
+            record["llama_error"] = repr(exc)[:120]
+            lcfg = lstate = lchain = None
+            gc.collect()
+
     if "value" not in record:
         raise RuntimeError(f"no bench config ran: {record}")
     print(json.dumps(record))

@@ -40,7 +40,9 @@ class ModelConfig:
     tie_embeddings: bool = False  # True = one shared param (true tying);
     # False = reference semantics: shared init, independent params
     # (model.py:134-138, SURVEY.md 2.3)
-    attn_impl: str = "auto"  # auto | naive | flash | ring
+    # "fused" = projection-natural QK-LN+RoPE+flash (ops/fused_attn);
+    # "auto" prefers it on TPU when shapes allow
+    attn_impl: str = "auto"  # auto | naive | flash | ring | fused
     ring_schedule: str = "zigzag"  # zigzag (balanced) | standard; zigzag
     # auto-falls back to standard when T doesn't divide 2*sequence
     norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)

@@ -92,6 +92,10 @@ class Attention:
         adrop_key, pdrop_key = (
             jax.random.split(key) if key is not None else (None, None)
         )
+        if impl == "fused" and (return_kv or self.q_norm is None):
+            # return_kv needs per-head K/V (prefill), and the kernel requires
+            # qk-norm; same math either way, so degrade to auto dispatch
+            impl = "auto"
         if self._use_fused(impl, t, deterministic) and not return_kv:
             return self._fused_call(x, sin, cos, pdrop_key, deterministic)
         with jax.named_scope("attention"):
@@ -199,7 +203,7 @@ class Attention:
             cos_full = _duplicate_interleaved(jnp.asarray(cos, jnp.float32))
             out = fused_attention_qkv(
                 qkv, self.q_norm.weight, self.k_norm.weight,
-                sin_full, cos_full, h, hkv,
+                sin_full, cos_full, h, hkv, True, self.q_norm.eps,
             )
             out = self.wo(out)
             out = dropout(out, self.dropout_rate, pdrop_key, deterministic)

@@ -35,15 +35,15 @@ Array = jax.Array
 _NEG_INF = -1e30  # avoids NaN from (-inf) - (-inf) in fully-masked rows
 
 
-def _auto_block(t: int) -> int:
-    """Largest power-of-two block <= 1024 that divides T.
+def _auto_block(t: int, cap: int = 1024) -> int:
+    """Largest power-of-two block <= cap that divides T.
 
     Measured on a v5e-class chip (B=16, H=12, T=1024, C=64, bench_kernels.py):
     fwd 12.3ms @ 128 -> 3.2ms @ 1024; fwd+bwd 19.5ms @ 128 -> 10.2ms @ 1024.
     The dominant cost is per-grid-step matmul issue overhead at tiny blocks,
     so bigger is strictly better until the VMEM working set (~12 MB at 1024
     for the dkv kernel) nears the 16 MB scoped limit."""
-    b = 1024
+    b = cap
     while b > 8 and t % b:
         b //= 2
     return min(b, t)
@@ -158,7 +158,13 @@ def _flash_forward(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
     )
     row_q = lambda b_, h_, iq, ik: iq  # noqa: E731
-    row_k = lambda b_, h_, iq, ik: ik  # noqa: E731
+    # trimmed causal grid: masked (ik > iq) steps are compute-skipped
+    # (pl.when); clamping their block index to the diagonal makes them
+    # alias the resident block, so they trigger no DMA either (r3)
+    if causal:
+        row_k = lambda b_, h_, iq, ik: jnp.minimum(ik, iq)  # noqa: E731
+    else:
+        row_k = lambda b_, h_, iq, ik: ik  # noqa: E731
     kv_head = lambda h_: h_ // groups  # noqa: E731
     q_head = lambda h_: h_  # noqa: E731
     out, lse = pl.pallas_call(
@@ -318,9 +324,14 @@ def _flash_backward(
         delta = delta - dlse.astype(jnp.float32)
 
     row_q34 = lambda b_, h_, iq, ik: iq  # noqa: E731 — grid (b,h,iq,ik)
-    row_k34 = lambda b_, h_, iq, ik: ik  # noqa: E731
-    row_q43 = lambda b_, h_, ik, iq: iq  # noqa: E731 — grid (b,h,ik,iq)
-    row_k43 = lambda b_, h_, ik, iq: ik  # noqa: E731
+    row_k43 = lambda b_, h_, ik, iq: ik  # noqa: E731 — grid (b,h,ik,iq)
+    # trimmed causal grid: skipped steps alias the diagonal block (no DMA)
+    if causal:
+        row_k34 = lambda b_, h_, iq, ik: jnp.minimum(ik, iq)  # noqa: E731
+        row_q43 = lambda b_, h_, ik, iq: jnp.maximum(iq, ik)  # noqa: E731
+    else:
+        row_k34 = lambda b_, h_, iq, ik: ik  # noqa: E731
+        row_q43 = lambda b_, h_, ik, iq: iq  # noqa: E731
     kv_head = lambda h_: h_ // groups  # noqa: E731
     q_head = lambda h_: h_  # noqa: E731
 
@@ -356,8 +367,14 @@ def _flash_backward(
             _act_spec(bk, c, row_k43, kv_head),
             _act_spec(bk, c, row_k43, kv_head),
             _act_spec(bq, c, row_q43, q_head),
-            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1),
+                lambda b_, h_, ik, iq: (b_, h_, row_q43(b_, h_, ik, iq), 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bq, 1),
+                lambda b_, h_, ik, iq: (b_, h_, row_q43(b_, h_, ik, iq), 0),
+            ),
         ],
         out_specs=[
             _act_spec(bk, c, row_k43, q_head),

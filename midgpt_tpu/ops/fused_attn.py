@@ -47,6 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from midgpt_tpu.models.layers import _rotation_matrix
+from midgpt_tpu.ops.flash import _auto_block, _causal_mask_block
 
 Array = jax.Array
 
@@ -70,19 +71,6 @@ def supported(n_head: int, n_kv_head: int, head_dim: int) -> bool:
 _FWD_CAP = {1: 1024, 2: 1024}
 _BWD_DQ_CAP = {1: 1024, 2: 1024}
 _BWD_DKV_CAP = {1: 1024, 2: 1024}
-
-
-def _auto_block(t: int, cap: int = 1024) -> int:
-    b = cap
-    while b > 8 and t % b:
-        b //= 2
-    return min(b, t)
-
-
-def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
-    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return rows >= cols
 
 
 def _ln_rope(x, w_ref, sin_ref, cos_ref, rot_ref, eps: float):
@@ -194,7 +182,7 @@ def _fwd_kernel(
 
 
 def _fused_forward(q, k, v, wq, wk, sin, cos, *, n_head, n_kv_head, causal,
-                   bq, bk, head_dim=None, koff=0, voff=0):
+                   bq, bk, head_dim=None, koff=0, voff=0, eps=1e-6):
     """koff/voff: lane-block offsets of K and V inside their arrays — 0 for
     split q/k/v inputs; the packed-qkv entry passes the SAME [B,T,F] array
     as q, k and v with offsets, so no slice copies ever happen."""
@@ -221,10 +209,16 @@ def _fused_forward(q, k, v, wq, wk, sin, cos, *, n_head, n_kv_head, causal,
     # kv head-block index for a q head-block: hpb==2 requires MHA (checked
     # in `supported`), so the pair maps 1:1; hpb==1 maps h -> h // groups.
     kv_of = (lambda g: g) if hpb == 2 else (lambda g: g // groups)
+    # trimmed causal grid: steps with ik > iq are compute-skipped (pl.when);
+    # clamping their data indices to the diagonal block makes them alias the
+    # block already resident, so the skipped steps also trigger NO DMA.
+    kclamp = (lambda ik, iq: jnp.minimum(ik, iq)) if causal else (
+        lambda ik, iq: ik
+    )
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        hpb=hpb, c=c, eps=1e-6,
+        hpb=hpb, c=c, eps=eps,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -232,17 +226,23 @@ def _fused_forward(q, k, v, wq, wk, sin, cos, *, n_head, n_kv_head, causal,
         in_specs=[
             pl.BlockSpec((1, bq, lanes), lambda b_, iq, g, ik: (b_, iq, g)),
             pl.BlockSpec(
-                (1, bk, lanes), lambda b_, iq, g, ik: (b_, ik, koff + kv_of(g))
+                (1, bk, lanes),
+                lambda b_, iq, g, ik: (b_, kclamp(ik, iq), koff + kv_of(g)),
             ),
             pl.BlockSpec(
-                (1, bk, lanes), lambda b_, iq, g, ik: (b_, ik, voff + kv_of(g))
+                (1, bk, lanes),
+                lambda b_, iq, g, ik: (b_, kclamp(ik, iq), voff + kv_of(g)),
             ),
             pl.BlockSpec((1, c), lambda *g: (0, 0)),  # wq
             pl.BlockSpec((1, c), lambda *g: (0, 0)),  # wk
             pl.BlockSpec((bq, c), lambda b_, iq, g, ik: (iq, 0)),  # sin_q
             pl.BlockSpec((bq, c), lambda b_, iq, g, ik: (iq, 0)),  # cos_q
-            pl.BlockSpec((bk, c), lambda b_, iq, g, ik: (ik, 0)),  # sin_k
-            pl.BlockSpec((bk, c), lambda b_, iq, g, ik: (ik, 0)),  # cos_k
+            pl.BlockSpec(
+                (bk, c), lambda b_, iq, g, ik: (kclamp(ik, iq), 0)
+            ),  # sin_k
+            pl.BlockSpec(
+                (bk, c), lambda b_, iq, g, ik: (kclamp(ik, iq), 0)
+            ),  # cos_k
             pl.BlockSpec((c, c), lambda *g: (0, 0)),  # rot
         ],
         out_specs=[
@@ -516,7 +516,8 @@ def _bwd_combined_kernel(
 
 
 def _fused_backward_combined(q, k, v, wq, wk, sin, cos, lse, do, out, *,
-                             n_head, n_kv_head, c, hpb, koff, voff, causal):
+                             n_head, n_kv_head, c, hpb, koff, voff, causal,
+                             eps=1e-6):
     b, t, _ = q.shape
     h2 = n_head // hpb
     groups = n_head // n_kv_head
@@ -540,7 +541,7 @@ def _fused_backward_combined(q, k, v, wq, wk, sin, cos, lse, do, out, *,
     dq, dk_h, dv_h, dwq_rows, dwk_rows = pl.pallas_call(
         functools.partial(
             _bwd_combined_kernel, scale=scale, causal=causal, t=t, nh2=h2,
-            hpb=hpb, c=c, eps=1e-6,
+            hpb=hpb, c=c, eps=eps,
         ),
         grid=(b, h2),
         in_specs=[
@@ -579,7 +580,8 @@ def _fused_backward_combined(q, k, v, wq, wk, sin, cos, lse, do, out, *,
 
 
 def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
-                    n_kv_head, causal, bq, bk, head_dim=None, koff=0, voff=0):
+                    n_kv_head, causal, bq, bk, head_dim=None, koff=0,
+                    voff=0, eps=1e-6):
     b, t, _ = q.shape
     c = head_dim if head_dim is not None else q.shape[-1] // n_head
     hpb = 2 if c == 64 else 1
@@ -587,7 +589,10 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
     groups = n_head // n_kv_head
     bq_dq = _auto_block(t, _BWD_DQ_CAP[hpb]) if bq is None else min(bq, t)
     bq_kv = _auto_block(t, _BWD_DKV_CAP[hpb]) if bq is None else min(bq, t)
-    bk_dq, bk_kv = bq_dq, bq_kv  # causal block-skip compares indices 1:1
+    if causal or bk is None:
+        bk_dq, bk_kv = bq_dq, bq_kv  # causal block-skip compares indices 1:1
+    else:
+        bk_dq = bk_kv = min(bk, t)
     scale = 1.0 / math.sqrt(c)
     lanes = hpb * c
 
@@ -603,7 +608,7 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
         dq, dk_h, dv_h, dwq_rows, dwk_rows = _fused_backward_combined(
             q, k, v, wq, wk, sin, cos, lse, do, out, n_head=n_head,
             n_kv_head=n_kv_head, c=c, hpb=hpb, koff=koff, voff=voff,
-            causal=causal,
+            causal=causal, eps=eps,
         )
         return _bwd_epilogue(
             dk_h, dv_h, dq, dwq_rows, dwk_rows, b, t, n_head, n_kv_head, c,
@@ -617,6 +622,14 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
     delta = jnp.transpose(prod.sum(-1), (0, 2, 1))[..., None]
 
     kv_of = (lambda g: g) if hpb == 2 else (lambda g: g // groups)
+    # trimmed causal grid (see _fused_forward): skipped steps alias the
+    # diagonal block so they cost no DMA
+    kcl = (lambda ik, iq: jnp.minimum(ik, iq)) if causal else (
+        lambda ik, iq: ik
+    )
+    qcl = (lambda iq, ik: jnp.maximum(iq, ik)) if causal else (
+        lambda iq, ik: iq
+    )
 
     wspec = pl.BlockSpec((1, c), lambda *g: (0, 0))
     rspec = pl.BlockSpec((c, c), lambda *g: (0, 0))
@@ -625,20 +638,22 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
     bq, bk = bq_dq, bk_dq
     nq, nk = t // bq, t // bk
     sq_q = pl.BlockSpec((bq, c), lambda b_, iq, g, ik: (iq, 0))
-    sk_q = pl.BlockSpec((bk, c), lambda b_, iq, g, ik: (ik, 0))
+    sk_q = pl.BlockSpec((bk, c), lambda b_, iq, g, ik: (kcl(ik, iq), 0))
     dq, dwq_rows = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            nh2=h2, hpb=hpb, c=c, eps=1e-6,
+            nh2=h2, hpb=hpb, c=c, eps=eps,
         ),
         grid=(b, nq, h2, nk),
         in_specs=[
             pl.BlockSpec((1, bq, lanes), lambda b_, iq, g, ik: (b_, iq, g)),
             pl.BlockSpec(
-                (1, bk, lanes), lambda b_, iq, g, ik: (b_, ik, koff + kv_of(g))
+                (1, bk, lanes),
+                lambda b_, iq, g, ik: (b_, kcl(ik, iq), koff + kv_of(g)),
             ),
             pl.BlockSpec(
-                (1, bk, lanes), lambda b_, iq, g, ik: (b_, ik, voff + kv_of(g))
+                (1, bk, lanes),
+                lambda b_, iq, g, ik: (b_, kcl(ik, iq), voff + kv_of(g)),
             ),
             pl.BlockSpec((1, bq, lanes), lambda b_, iq, g, ik: (b_, iq, g)),
             pl.BlockSpec((1, hpb, bq, 1), lambda b_, iq, g, ik: (b_, g, iq, 0)),
@@ -669,25 +684,33 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
     # ---- dK/dV (per q-head) + dwk: grid (b, ik, h2, iq) ----------------
     bq, bk = bq_kv, bk_kv
     nq, nk = t // bq, t // bk
-    sq_k = pl.BlockSpec((bq, c), lambda b_, ik, g, iq: (iq, 0))
+    sq_k = pl.BlockSpec((bq, c), lambda b_, ik, g, iq: (qcl(iq, ik), 0))
     sk_k = pl.BlockSpec((bk, c), lambda b_, ik, g, iq: (ik, 0))
     dk_h, dv_h, dwk_rows = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            nh2=h2, hpb=hpb, c=c, eps=1e-6,
+            nh2=h2, hpb=hpb, c=c, eps=eps,
         ),
         grid=(b, nk, h2, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, lanes), lambda b_, ik, g, iq: (b_, iq, g)),
+            pl.BlockSpec(
+                (1, bq, lanes), lambda b_, ik, g, iq: (b_, qcl(iq, ik), g)
+            ),
             pl.BlockSpec(
                 (1, bk, lanes), lambda b_, ik, g, iq: (b_, ik, koff + kv_of(g))
             ),
             pl.BlockSpec(
                 (1, bk, lanes), lambda b_, ik, g, iq: (b_, ik, voff + kv_of(g))
             ),
-            pl.BlockSpec((1, bq, lanes), lambda b_, ik, g, iq: (b_, iq, g)),
-            pl.BlockSpec((1, hpb, bq, 1), lambda b_, ik, g, iq: (b_, g, iq, 0)),
-            pl.BlockSpec((1, hpb, bq, 1), lambda b_, ik, g, iq: (b_, g, iq, 0)),
+            pl.BlockSpec(
+                (1, bq, lanes), lambda b_, ik, g, iq: (b_, qcl(iq, ik), g)
+            ),
+            pl.BlockSpec(
+                (1, hpb, bq, 1), lambda b_, ik, g, iq: (b_, g, qcl(iq, ik), 0)
+            ),
+            pl.BlockSpec(
+                (1, hpb, bq, 1), lambda b_, ik, g, iq: (b_, g, qcl(iq, ik), 0)
+            ),
             wspec, wspec, sq_k, sq_k, sk_k, sk_k, rspec,
         ],
         out_specs=[
@@ -742,7 +765,7 @@ def _bwd_epilogue(dk_h, dv_h, dq, dwq_rows, dwk_rows, b, t, n_head,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
 def fused_attention(
     q: Array,  # [B, T, H*C]  raw (pre-LN, pre-RoPE) projections
     k: Array,  # [B, T, Hkv*C]
@@ -756,6 +779,7 @@ def fused_attention(
     causal: bool = True,
     block_q: tp.Optional[int] = None,
     block_k: tp.Optional[int] = None,
+    eps: float = 1e-6,
 ) -> Array:
     """QK-LayerNorm + RoPE + causal flash attention, projection-natural.
 
@@ -763,25 +787,25 @@ def fused_attention(
     Differentiable in q, k, v, wq, wk."""
     out, _ = _fused_forward(
         q, k, v, wq, wk, sin, cos, n_head=n_head, n_kv_head=n_kv_head,
-        causal=causal, bq=block_q, bk=block_k,
+        causal=causal, bq=block_q, bk=block_k, eps=eps,
     )
     return out
 
 
 def _fused_vjp_fwd(q, k, v, wq, wk, sin, cos, n_head, n_kv_head, causal,
-                   block_q, block_k):
+                   block_q, block_k, eps):
     out, lse = _fused_forward(
         q, k, v, wq, wk, sin, cos, n_head=n_head, n_kv_head=n_kv_head,
-        causal=causal, bq=block_q, bk=block_k,
+        causal=causal, bq=block_q, bk=block_k, eps=eps,
     )
     return out, (q, k, v, wq, wk, sin, cos, out, lse)
 
 
-def _fused_vjp_bwd(n_head, n_kv_head, causal, block_q, block_k, res, do):
+def _fused_vjp_bwd(n_head, n_kv_head, causal, block_q, block_k, eps, res, do):
     q, k, v, wq, wk, sin, cos, out, lse = res
     dq, dk, dv, dwq, dwk = _fused_backward(
         q, k, v, wq, wk, sin, cos, out, lse, do, n_head=n_head,
-        n_kv_head=n_kv_head, causal=causal, bq=block_q, bk=block_k,
+        n_kv_head=n_kv_head, causal=causal, bq=block_q, bk=block_k, eps=eps,
     )
     return dq, dk, dv, dwq, dwk, None, None
 
@@ -800,7 +824,7 @@ def _packed_geometry(qkv, n_head, n_kv_head):
     return c, koff, voff
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def fused_attention_qkv(
     qkv: Array,  # [B, T, (H + 2*Hkv) * C] — raw fused-projection output
     wq: Array,
@@ -810,6 +834,7 @@ def fused_attention_qkv(
     n_head: int,
     n_kv_head: int,
     causal: bool = True,
+    eps: float = 1e-6,
 ) -> Array:
     """Packed-qkv entry: the kernels read Q, K and V straight out of the
     projection output via lane-offset block index maps — the q/k/v slice
@@ -820,26 +845,28 @@ def fused_attention_qkv(
     out, _ = _fused_forward(
         qkv, qkv, qkv, wq, wk, sin, cos, n_head=n_head, n_kv_head=n_kv_head,
         causal=causal, bq=None, bk=None, head_dim=c, koff=koff, voff=voff,
+        eps=eps,
     )
     return out
 
 
-def _packed_vjp_fwd(qkv, wq, wk, sin, cos, n_head, n_kv_head, causal):
+def _packed_vjp_fwd(qkv, wq, wk, sin, cos, n_head, n_kv_head, causal, eps):
     c, koff, voff = _packed_geometry(qkv, n_head, n_kv_head)
     out, lse = _fused_forward(
         qkv, qkv, qkv, wq, wk, sin, cos, n_head=n_head, n_kv_head=n_kv_head,
         causal=causal, bq=None, bk=None, head_dim=c, koff=koff, voff=voff,
+        eps=eps,
     )
     return out, (qkv, wq, wk, sin, cos, out, lse)
 
 
-def _packed_vjp_bwd(n_head, n_kv_head, causal, res, do):
+def _packed_vjp_bwd(n_head, n_kv_head, causal, eps, res, do):
     qkv, wq, wk, sin, cos, out, lse = res
     c, koff, voff = _packed_geometry(qkv, n_head, n_kv_head)
     dq, dk, dv, dwq, dwk = _fused_backward(
         qkv, qkv, qkv, wq, wk, sin, cos, out, lse, do, n_head=n_head,
         n_kv_head=n_kv_head, causal=causal, bq=None, bk=None, head_dim=c,
-        koff=koff, voff=voff,
+        koff=koff, voff=voff, eps=eps,
     )
     dqkv = jnp.concatenate([dq, dk, dv], axis=-1)
     return dqkv, dwq, dwk, None, None
@@ -849,7 +876,7 @@ fused_attention_qkv.defvjp(_packed_vjp_fwd, _packed_vjp_bwd)
 
 
 def fused_attention_reference(q, k, v, wq, wk, sin, cos, n_head, n_kv_head,
-                              causal=True):
+                              causal=True, eps=1e-6):
     """jnp oracle: the exact unfused path (LN -> transpose -> RoPE ->
     attention -> transpose back), f32 LN to match the kernel."""
     from midgpt_tpu.ops.attention import naive_attention
@@ -862,7 +889,7 @@ def fused_attention_reference(q, k, v, wq, wk, sin, cos, n_head, n_kv_head,
         mean = jnp.mean(x32, axis=-1, keepdims=True)
         cent = x32 - mean
         var = jnp.mean(jnp.square(cent), axis=-1, keepdims=True)
-        return cent * jax.lax.rsqrt(var + 1e-6) * w.astype(jnp.float32)
+        return cent * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
 
     rot = jnp.asarray(_rotation_matrix(c, "float32"))
 
