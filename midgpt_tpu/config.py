@@ -46,8 +46,12 @@ class ModelConfig:
     ring_schedule: str = "zigzag"  # zigzag (balanced) | standard; zigzag
     # auto-falls back to standard when T doesn't divide 2*sequence
     norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)
-    remat: str = "full"  # full | dots | none  (model.py:149 uses full)
-    scan_unroll: int = 1  # lax.scan unroll over layers (model.py:154-155)
+    # remat "auto": train() picks none/dots/full by an HBM-fit estimate at
+    # startup and logs the choice (resolve_auto_knobs) — remat=none with a
+    # fully-unrolled scan measured 1.5-2.6x faster than remat=full when it
+    # fits (PERF.md); outside train() (sampling) "auto" behaves as none
+    remat: str = "full"  # auto | full | dots | none  (model.py:149 uses full)
+    scan_unroll: int = 1  # lax.scan unroll over layers; 0 = n_layer (full)
 
     @property
     def kv_heads(self) -> int:

@@ -462,11 +462,16 @@ class GPT:
                     body,
                     policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 )
-            elif cfg.remat != "none":
+            elif cfg.remat not in ("none", "auto"):
+                # "auto" reaching the model means no trainer resolved it
+                # (inference/sampling) — remat is moot without gradients,
+                # so it behaves as "none"; train() resolves it by HBM fit
+                # (midgpt_tpu.train.resolve_auto_knobs)
                 raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
+            unroll = cfg.scan_unroll if cfg.scan_unroll else cfg.n_layer
             h, kvs = jax.lax.scan(
-                body, h, (self.blocks, scan_keys), unroll=cfg.scan_unroll
+                body, h, (self.blocks, scan_keys), unroll=unroll
             )
             h = self.ln_f(h)
             return (h, kvs) if return_kv else h
@@ -553,7 +558,8 @@ def decode_step(
         return x, (ck, cv)
 
     h, (new_k, new_v) = jax.lax.scan(
-        body, h, (model.blocks, cache.k, cache.v), unroll=cfg.scan_unroll
+        body, h, (model.blocks, cache.k, cache.v),
+        unroll=cfg.scan_unroll if cfg.scan_unroll else cfg.n_layer,
     )
     h = model.ln_f(h)
     logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]

