@@ -108,6 +108,8 @@ def _measure(cfg, state, chain, n_steps: int = 10):
 def main() -> None:
     from midgpt_tpu.utils.metrics import flops_per_token, mfu
 
+    t_start = time.perf_counter()
+
     # persistent executable cache: repeat runs (and the fallback ladder)
     # skip recompiles
     try:
@@ -237,6 +239,19 @@ def main() -> None:
             exc.__traceback__ = None
             record["llama_error"] = repr(exc)[:120]
             lcfg = lstate = lchain = None
+            gc.collect()
+
+    # --- auxiliary rung: serving (prefill + KV-cached decode) ------------
+    # skipped when the training rungs already consumed most of the driver
+    # budget (the relay post-mortem in PERF.md: never run into the timeout)
+    if time.perf_counter() - t_start < 300:
+        try:
+            from scripts.bench_decode import measure_decode
+
+            record.update(measure_decode())
+        except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
+            exc.__traceback__ = None
+            record["decode_error"] = repr(exc)[:120]
             gc.collect()
 
     if "value" not in record:

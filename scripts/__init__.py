@@ -1,0 +1,1 @@
+# makes scripts/ importable so bench.py can reuse bench_decode.measure_decode
