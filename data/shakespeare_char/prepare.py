@@ -68,6 +68,7 @@ def main() -> None:
     data = np.array([stoi[c] for c in text], dtype=np.uint16)
     n = len(data)
     train, val = data[: int(n * 0.9)], data[int(n * 0.9) :]
+    os.makedirs(args.out_dir, exist_ok=True)
     train.tofile(os.path.join(args.out_dir, "train.bin"))
     val.tofile(os.path.join(args.out_dir, "val.bin"))
     with open(os.path.join(args.out_dir, "meta.pkl"), "wb") as f:

@@ -61,15 +61,26 @@ def measure_decode(include_sliding: bool = False) -> dict:
     key = jax.random.PRNGKey(1)
     prompt = jax.random.randint(key, (b, p), 0, cfg.vocab_size)
 
-    # prefill alone, timed on its logits so XLA can't dead-code it
-    # (a max_new_tokens=0 sampler returns [B,0] and the whole forward
-    # gets eliminated — measured 6M "tok/s")
+    # prefill timed on its FULL output (logits AND cache): returning only
+    # logits lets XLA dead-code the ~150 MB of KV-cache writes, and a
+    # max_new_tokens=0 sampler loses the whole forward (measured 6M "tok/s")
     from midgpt_tpu.models.gpt import KVCache, prefill
 
     cache = KVCache.init(cfg, b, p, dtype=jnp.bfloat16)
-    t_prefill = _timed(
-        jax.jit(lambda m, t, c: prefill(m, t, c)[0]), model, prompt, cache
-    )
+
+    def _sync_all(out):
+        return sum(float(jnp.sum(l[..., -1].astype(jnp.float32)))
+                   for l in jax.tree.leaves(out))
+
+    pf = jax.jit(prefill)
+    _sync_all(pf(model, prompt, cache))
+    t0 = time.perf_counter()
+    _sync_all(pf(model, prompt, cache))
+    t1 = time.perf_counter()
+    outs = [pf(model, prompt, cache) for _ in range(4)]
+    _sync_all(outs[-1])
+    t2 = time.perf_counter()
+    t_prefill = max(1e-9, ((t2 - t1) - (t1 - t0)) / 3)
     # decode rate = delta between two samplers (prefill cost cancels)
     n_dec = 256
     t_one = _timed(make_sampler(1, temperature=1.0), model, prompt, key)
@@ -83,19 +94,24 @@ def measure_decode(include_sliding: bool = False) -> dict:
         "decode_ms_per_tok": round(dec_per_tok * 1e3, 3),
     }
     if include_sliding:
-        # past-window sliding: full-window prompt, 64 steps in each mode
+        # past-window sliding: full-window prompt; per-token rate from the
+        # mode-matched delta between 1-step and (1+n)-step samplers (same
+        # pattern as the in-window block — the baseline's one step and the
+        # prefill cost cancel exactly)
         n_slide = 64
         prompt_w = jax.random.randint(
             key, (b, cfg.block_size), 0, cfg.vocab_size
         )
-        t_kv = _timed(make_sampler(n_slide, sliding="kv"), model, prompt_w, key)
-        t_exact = _timed(
-            make_sampler(n_slide, sliding="exact"), model, prompt_w, key
-        )
-        # subtract the shared full-window prefill cost
-        t_pw = _timed(make_sampler(1, sliding="kv"), model, prompt_w, key)
-        kv_per_tok = max(1e-9, (t_kv - t_pw) / n_slide)
-        exact_per_tok = max(1e-9, (t_exact - t_pw) / n_slide)
+        per_tok = {}
+        for mode in ("kv", "exact"):
+            t_one = _timed(
+                make_sampler(1, sliding=mode), model, prompt_w, key
+            )
+            t_many = _timed(
+                make_sampler(1 + n_slide, sliding=mode), model, prompt_w, key
+            )
+            per_tok[mode] = max(1e-9, (t_many - t_one) / n_slide)
+        kv_per_tok, exact_per_tok = per_tok["kv"], per_tok["exact"]
         record.update(
             {
                 "slide_kv_tok_s": round(b / kv_per_tok, 1),
@@ -109,8 +125,10 @@ def measure_decode(include_sliding: bool = False) -> dict:
 def main() -> None:
     record = {"device": jax.devices()[0].device_kind}
     record.update(measure_decode(include_sliding=True))
-    os.makedirs("artifacts", exist_ok=True)
-    with open("artifacts/bench_decode.json", "w") as f:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outdir = os.path.join(repo, "artifacts")
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, "bench_decode.json"), "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record))
 

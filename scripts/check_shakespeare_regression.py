@@ -1,0 +1,87 @@
+"""Golden-loss regression check on the synthetic shakespeare_char recipe.
+
+The reference's correctness bar is trained curves
+(/root/reference/README.md:55: shakespeare_char to ~1.47 val on the real
+tinyshakespeare). This environment has zero egress, so the tracked stand-in
+(VERDICT r2 Next #6) is the deterministic synthetic corpus: the full
+5000-step shakespeare_char recipe must reach **val <= 0.75** (r2 measured
+0.6995, r3 re-measured below; the margin covers seed/jitter). The
+real-data golden commands stay documented in PARITY.md.
+
+    PYTHONPATH=. python scripts/check_shakespeare_regression.py
+        [--rundir=...] [--threshold=0.75]
+
+Exit 0 iff the final val loss clears the threshold; writes the run under
+artifacts/shakespeare_synth_check/ (metrics.jsonl + summary.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rundir", default=None)
+    ap.add_argument("--threshold", type=float, default=0.75)
+    ap.add_argument("--max_steps", type=int, default=5000)
+    args = ap.parse_args()
+
+    workdir = args.rundir or tempfile.mkdtemp(prefix="shk_synth_")
+    cleanup = args.rundir is None
+    data_dir = os.path.join(workdir, "data")
+    rundir = os.path.join(workdir, "run")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "data/shakespeare_char/prepare.py"),
+         "--synthetic", "--out_dir", data_dir],
+        check=True, env=env,
+    )
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "launch.py"),
+         "--config=shakespeare_char", f"--rundir={rundir}",
+         "--set", f"data_dir={data_dir}", f"max_steps={args.max_steps}",
+         "ckpt_interval=100000"],
+        check=True, env=env,
+    )
+
+    val = None
+    with open(os.path.join(rundir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "loss/val" in rec:
+                val = rec["loss/val"]
+    if val is None:
+        raise RuntimeError("run produced no val-loss points")
+
+    ok = val <= args.threshold
+    summary = {
+        "final_val_loss": val,
+        "threshold": args.threshold,
+        "max_steps": args.max_steps,
+        "ok": bool(ok),
+    }
+    outdir = os.path.join(REPO, "artifacts", "shakespeare_synth_check")
+    os.makedirs(outdir, exist_ok=True)
+    shutil.copy(os.path.join(rundir, "metrics.jsonl"), outdir)
+    with open(os.path.join(outdir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    if cleanup:
+        shutil.rmtree(workdir, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
