@@ -121,11 +121,14 @@ def main() -> None:
     n_dev = jax.device_count()
 
     # --- headline: flagship-family (openwebtext_xl per-layer shape) ------
-    # ladder fastest-measured first (PERF.md r2: L6 B=16 59.6%, L8 B=8
-    # 58.5%); fall back if the compiler/allocator rejects a rung
+    # ladder fastest-measured first (PERF.md r3 with the combined-backward
+    # kernels: L6 B=20 68.8%, L8 B=12 68.5%, L6 B=16 66.8%; B=22/24 regress
+    # — HBM compression returns); fall back if the compiler rejects a rung
     record = {}
     last_err = None
-    for xl_layers, xl_batch in ((6, 16 * n_dev), (8, 8 * n_dev), (6, 8 * n_dev)):
+    for xl_layers, xl_batch in (
+        (6, 20 * n_dev), (8, 12 * n_dev), (6, 16 * n_dev), (8, 8 * n_dev),
+    ):
         try:
             xcfg, xstate, xchain = _run_config(
                 "none", xl_batch, base="openwebtext_xl", n_layer=xl_layers,

@@ -44,6 +44,7 @@ def main() -> None:
     ap.add_argument("--synthetic", action="store_true")
     ap.add_argument("--out_dir", default=os.path.dirname(__file__) or ".")
     args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
 
     if args.synthetic:
         text = synthetic_corpus()
@@ -68,7 +69,6 @@ def main() -> None:
     data = np.array([stoi[c] for c in text], dtype=np.uint16)
     n = len(data)
     train, val = data[: int(n * 0.9)], data[int(n * 0.9) :]
-    os.makedirs(args.out_dir, exist_ok=True)
     train.tofile(os.path.join(args.out_dir, "train.bin"))
     val.tofile(os.path.join(args.out_dir, "val.bin"))
     with open(os.path.join(args.out_dir, "meta.pkl"), "wb") as f:

@@ -45,6 +45,35 @@ Array = jax.Array
 KeyArray = jax.Array
 
 
+def _fused_attention_sharded(qkv, wq, wk, sin, cos, h, hkv, eps):
+    """Run the fused kernel per data shard. Under a live multi-device mesh
+    a bare ``pallas_call`` (an opaque custom call) would make GSPMD gather
+    the batch-sharded activations onto every device; wrapping in
+    ``shard_map`` over the data axes keeps each device's kernel on its own
+    local batch — the multi-chip path for the fused attention. Heads/T
+    stay whole (the TP/SP cases take the unfused path, _use_fused)."""
+    from midgpt_tpu.ops.fused_attn import fused_attention_qkv
+    from midgpt_tpu.parallel.sharding import current_mesh
+
+    mesh = current_mesh()
+    data_axes = ("replica", "fsdp")
+    if mesh is None or all(mesh.shape.get(a, 1) == 1 for a in data_axes):
+        return fused_attention_qkv(qkv, wq, wk, sin, cos, h, hkv, True, eps)
+
+    from jax.sharding import PartitionSpec as P
+
+    fn = lambda q_, wq_, wk_, s_, c_: fused_attention_qkv(  # noqa: E731
+        q_, wq_, wk_, s_, c_, h, hkv, True, eps
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(data_axes), P(), P(), P(), P()),
+        out_specs=P(data_axes),
+        check_vma=False,
+    )(qkv, wq, wk, sin, cos)
+
+
 @module
 class Attention:
     """Causal self-attention with QK-norm + RoPE (parity: model.py:34-81)."""
@@ -167,18 +196,30 @@ class Attention:
             and t % 128 == 0
             and (self.dropout_rate == 0.0 or deterministic)
         )
+        mesh = current_mesh()
+        mesh_sharded = mesh is not None and (
+            mesh.shape.get("tensor", 1) > 1
+            or mesh.shape.get("sequence", 1) > 1
+        )
         if impl == "fused":
             assert shape_ok, (
                 "attn_impl='fused' requires qk-norm, T % 128 == 0, no "
                 "attention dropout, and a supported head shape "
                 "(C % 128 == 0, or C == 64 with MHA)"
             )
+            assert not mesh_sharded, (
+                "attn_impl='fused' cannot run under a tensor- or "
+                "sequence-sharded mesh (heads/T must stay whole per "
+                "device); use attn_impl='auto' (falls back) or 'ring'"
+            )
             return True
         from midgpt_tpu.utils.platform import is_tpu_backend
 
-        mesh = current_mesh()
-        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
-            return False  # TP shards heads; packed-qkv path keeps lanes whole
+        if mesh_sharded:
+            # TP shards heads (packed lanes must stay whole) and SP shards
+            # T (the kernel grid assumes the full sequence) — both keep the
+            # unfused path, which has per-axis sharding rules / ring
+            return False
         return shape_ok and is_tpu_backend()
 
     def head_dim(self) -> int:
@@ -201,9 +242,9 @@ class Attention:
             qkv = shard_act(qkv, "batch", "seq", None)
             sin_full = _duplicate_interleaved(jnp.asarray(sin, jnp.float32))
             cos_full = _duplicate_interleaved(jnp.asarray(cos, jnp.float32))
-            out = fused_attention_qkv(
+            out = _fused_attention_sharded(
                 qkv, self.q_norm.weight, self.k_norm.weight,
-                sin_full, cos_full, h, hkv, True, self.q_norm.eps,
+                sin_full, cos_full, h, hkv, self.q_norm.eps,
             )
             out = self.wo(out)
             out = dropout(out, self.dropout_rate, pdrop_key, deterministic)

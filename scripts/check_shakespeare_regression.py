@@ -43,6 +43,16 @@ def main() -> None:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
+    try:
+        ok, summary = _run(args, workdir, data_dir, rundir, env)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(summary))
+    sys.exit(0 if ok else 1)
+
+
+def _run(args, workdir, data_dir, rundir, env):
     subprocess.run(
         [sys.executable, os.path.join(REPO, "data/shakespeare_char/prepare.py"),
          "--synthetic", "--out_dir", data_dir],
@@ -77,10 +87,7 @@ def main() -> None:
     shutil.copy(os.path.join(rundir, "metrics.jsonl"), outdir)
     with open(os.path.join(outdir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
-    print(json.dumps(summary))
-    if cleanup:
-        shutil.rmtree(workdir, ignore_errors=True)
-    sys.exit(0 if ok else 1)
+    return ok, summary
 
 
 if __name__ == "__main__":

@@ -165,3 +165,49 @@ def test_packed_qkv_matches_split(h, hkv, c):
     np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(dqkv_split), atol=1e-6)
     np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gs[3]), atol=1e-6)
     np.testing.assert_allclose(np.asarray(gp[2]), np.asarray(gs[4]), atol=1e-6)
+
+
+def test_fused_under_data_sharded_mesh():
+    """The fused path under a live replica x fsdp mesh runs per-shard via
+    shard_map (models/gpt.py _fused_attention_sharded): forward and grads
+    — including the REPLICATED LN-weight grads, which must come back
+    summed across shards — must match the unsharded fused run."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from midgpt_tpu.config import MeshConfig, ModelConfig
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import axis_rules
+
+    cfg = ModelConfig(
+        block_size=128, vocab_size=96, n_layer=2, n_head=4, n_embd=256,
+        dropout=0.0, attn_impl="fused", remat="none", qk_norm=True,
+    )
+    model = GPT.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 128), 0, 96)
+
+    def loss(m, toks):
+        lg = m(toks)
+        return jnp.mean(lg.astype(jnp.float32) ** 2)
+
+    l_ref = jax.jit(loss)(model, tokens)
+    g_ref = jax.jit(jax.grad(loss))(model, tokens)
+
+    mesh = create_mesh(MeshConfig(replica=2, fsdp=4, sequence=1, tensor=1))
+    tok_sharded = jax.device_put(
+        tokens, NamedSharding(mesh, P(("replica", "fsdp")))
+    )
+
+    def sharded_loss(m, toks):
+        with axis_rules(mesh):
+            return loss(m, toks)
+
+    l_sh = jax.jit(sharded_loss)(model, tok_sharded)
+    g_sh = jax.jit(jax.grad(sharded_loss))(model, tok_sharded)
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        )
