@@ -228,11 +228,8 @@ class Attention:
 
     def _fused_call(self, x, sin, cos, pdrop_key, deterministic):
         from midgpt_tpu.models.layers import _duplicate_interleaved
-        from midgpt_tpu.ops.fused_attn import fused_attention_qkv
 
-        b, t, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
-        c = self.head_dim()
         with jax.named_scope("fused_attention"):
             qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
             # packed entry: the kernel reads q/k/v via lane-offset index
@@ -303,6 +300,18 @@ class Attention:
         return self.wo(out), cache_k, cache_v
 
 
+def mlp_hidden_dim(cfg: ModelConfig) -> int:
+    """MLP hidden width. Fractional ratios (SwiGLU's 8/3) round UP to a
+    multiple of 256 — int(8/3 * 4096) = 10922 is not even lane-aligned
+    and tiles terribly on the 128-wide MXU, while 256-rounding gives
+    exactly Llama's published 11008 (the same rule Llama uses:
+    multiple_of=256). Integral products (GELU 4x) are untouched."""
+    f = cfg.mlp_ratio * cfg.n_embd
+    if f == int(f):
+        return int(f)
+    return 256 * -(-int(f) // 256)
+
+
 @module
 class MLP:
     """GELU MLP (parity: model.py:17-31) or SwiGLU (Llama family)."""
@@ -315,7 +324,7 @@ class MLP:
     @staticmethod
     def init(key: KeyArray, cfg: ModelConfig) -> "MLP":
         k1, k2, k3 = jax.random.split(key, 3)
-        f = int(cfg.mlp_ratio * cfg.n_embd)
+        f = mlp_hidden_dim(cfg)
         if cfg.mlp == "swiglu":
             gate = Linear.init(k3, cfg.n_embd, f)
         elif cfg.mlp == "gelu":

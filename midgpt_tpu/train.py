@@ -315,15 +315,15 @@ def resolve_auto_knobs(cfg: ExperimentConfig, n_devices: int,
         except Exception:  # pragma: no cover — backend without memory_stats
             hbm_bytes = int(16e9)
 
+    from midgpt_tpu.models.gpt import mlp_hidden_dim
+
     c, hkv = m.head_dim, m.kv_heads
     f = (m.n_head + 2 * hkv) * c
-    if m.mlp == "swiglu":
-        hidden = 2 * int(m.mlp_ratio * m.n_embd)
-    else:
-        hidden = int(m.mlp_ratio * m.n_embd)
+    mh = mlp_hidden_dim(m)
+    hidden = 2 * mh if m.mlp == "swiglu" else mh
     per_layer_params = (
         m.n_embd * f + m.n_head * c * m.n_embd
-        + (3 if m.mlp == "swiglu" else 2) * m.n_embd * int(m.mlp_ratio * m.n_embd)
+        + (3 if m.mlp == "swiglu" else 2) * m.n_embd * mh
     )
     n_params = m.n_layer * per_layer_params + 2 * m.vocab_size * m.n_embd
     state_bytes = n_params * 12  # f32 params + Adam m,v (donated step)

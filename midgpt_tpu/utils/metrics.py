@@ -41,7 +41,9 @@ def flops_per_token(model: ModelConfig, seq_len: tp.Optional[int] = None) -> flo
     t = seq_len or model.block_size
     d = model.n_embd
     c = model.head_dim
-    f = int(model.mlp_ratio * d)
+    from midgpt_tpu.models.gpt import mlp_hidden_dim
+
+    f = mlp_hidden_dim(model)
     # parameter FLOPs (2 per MAC, x3 for fwd+bwd)
     qkv = d * (model.n_head + 2 * model.kv_heads) * c
     proj = model.n_head * c * d
