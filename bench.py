@@ -11,9 +11,14 @@ published efficiency number; see BASELINE.md "north star"). MFU is
 per-FLOP, so the depth-scaled number tracks the full-depth one; the
 1.5B's smaller embed/head FLOP share makes it conservative if anything.
 
-Auxiliary rung: GPT-2-small (124M, openwebtext config) MFU — a stricter
-shape for this hardware (768/64 projections half-fill the MXU; see
-PERF.md "measured ceilings") tracked across rounds under gpt2s_* keys.
+Auxiliary rungs:
+- gpt2s_*: GPT-2-small (124M, openwebtext config) MFU — a stricter shape
+  for this hardware (768/64 projections half-fill the MXU; see PERF.md
+  "measured ceilings"), tracked across rounds.
+- llama_*: llama_7b-family per-layer shape (D=4096, H=32/Hkv=8 GQA,
+  SwiGLU, C=128, T=2048), depth-scaled to one chip (r3).
+- decode_*: serving — prefill + KV-cached decode tok/s (r3; skipped if
+  the training rungs consumed most of the driver budget).
 """
 
 from __future__ import annotations
@@ -96,13 +101,18 @@ def _run_config(
     return cfg, state, chain
 
 
-def _measure(cfg, state, chain, n_steps: int = 10):
-    """(tokens/sec, step_ms) from a chained-steps delta."""
-    t_1, state = chain(state, 1)  # RTT + 1 step
-    t_n, state = chain(state, n_steps + 1)
-    elapsed = t_n - t_1
-    tokens_per_sec = cfg.batch_size * cfg.model.block_size * n_steps / elapsed
-    return tokens_per_sec, 1e3 * elapsed / n_steps, state
+def _measure(cfg, state, chain, n_steps: int = 10, repeats: int = 3):
+    """(tokens/sec, step_ms) from chained-steps deltas; median of
+    ``repeats`` measures (single measures spread ~2% run-to-run on this
+    chip — relay jitter + clock variation)."""
+    rates = []
+    for _ in range(repeats):
+        t_1, state = chain(state, 1)  # RTT + 1 step
+        t_n, state = chain(state, n_steps + 1)
+        rates.append((t_n - t_1) / n_steps)
+    step_s = sorted(rates)[len(rates) // 2]
+    tokens_per_sec = cfg.batch_size * cfg.model.block_size / step_s
+    return tokens_per_sec, 1e3 * step_s, state
 
 
 def main() -> None:

@@ -35,6 +35,11 @@ class ModelConfig:
     n_kv_head: tp.Optional[int] = None  # None => MHA (= n_head); < n_head => GQA
     mlp: str = "gelu"  # "gelu" (GPT-2 style, 4x) | "swiglu" (Llama style)
     mlp_ratio: float = 4.0  # hidden = ratio * n_embd (swiglu: per-branch width)
+    # exact hidden width; None = ratio * n_embd, with FRACTIONAL products
+    # rounded up to a multiple of 256 (Llama's multiple_of rule; also the
+    # MXU-friendly width — r3). Set explicitly to pin any width, e.g. to
+    # restore a checkpoint trained before the rounding rule existed.
+    mlp_hidden: tp.Optional[int] = None
     rope_base: float = 10000.0
     qk_norm: bool = True  # per-head QK-LayerNorm (model.py:52-53)
     tie_embeddings: bool = False  # True = one shared param (true tying);

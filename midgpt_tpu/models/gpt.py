@@ -305,7 +305,10 @@ def mlp_hidden_dim(cfg: ModelConfig) -> int:
     multiple of 256 — int(8/3 * 4096) = 10922 is not even lane-aligned
     and tiles terribly on the 128-wide MXU, while 256-rounding gives
     exactly Llama's published 11008 (the same rule Llama uses:
-    multiple_of=256). Integral products (GELU 4x) are untouched."""
+    multiple_of=256). Integral products (GELU 4x) are untouched;
+    cfg.mlp_hidden pins an exact width (e.g. for old checkpoints)."""
+    if cfg.mlp_hidden is not None:
+        return cfg.mlp_hidden
     f = cfg.mlp_ratio * cfg.n_embd
     if f == int(f):
         return int(f)
