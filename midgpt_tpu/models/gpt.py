@@ -46,32 +46,56 @@ KeyArray = jax.Array
 
 
 def _fused_attention_sharded(qkv, wq, wk, sin, cos, h, hkv, eps):
-    """Run the fused kernel per data shard. Under a live multi-device mesh
-    a bare ``pallas_call`` (an opaque custom call) would make GSPMD gather
-    the batch-sharded activations onto every device; wrapping in
-    ``shard_map`` over the data axes keeps each device's kernel on its own
-    local batch — the multi-chip path for the fused attention. Heads/T
-    stay whole (the TP/SP cases take the unfused path, _use_fused)."""
-    from midgpt_tpu.ops.fused_attn import fused_attention_qkv
-    from midgpt_tpu.parallel.sharding import current_mesh
+    """Run the fused kernel per shard. Under a live multi-device mesh a
+    bare ``pallas_call`` (an opaque custom call) would make GSPMD gather
+    the sharded activations onto every device; wrapping in ``shard_map``
+    keeps each device's kernel on its local batch — and, under TP, on its
+    local HEADS: tensor shards the head dim, each shard running the
+    split-input kernel with H/tp (and Hkv/tp) heads. T stays whole (the
+    SP case takes the ring path, _use_fused)."""
+    from midgpt_tpu.ops.fused_attn import fused_attention, fused_attention_qkv
+    from midgpt_tpu.parallel.sharding import current_mesh, shard_act
 
     mesh = current_mesh()
     data_axes = ("replica", "fsdp")
-    if mesh is None or all(mesh.shape.get(a, 1) == 1 for a in data_axes):
+    tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+    if mesh is None or (
+        tp == 1 and all(mesh.shape.get(a, 1) == 1 for a in data_axes)
+    ):
         return fused_attention_qkv(qkv, wq, wk, sin, cos, h, hkv, True, eps)
 
     from jax.sharding import PartitionSpec as P
 
-    fn = lambda q_, wq_, wk_, s_, c_: fused_attention_qkv(  # noqa: E731
-        q_, wq_, wk_, s_, c_, h, hkv, True, eps
+    if tp == 1:
+        fn = lambda q_, wq_, wk_, s_, c_: fused_attention_qkv(  # noqa: E731
+            q_, wq_, wk_, s_, c_, h, hkv, True, eps
+        )
+        return jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(data_axes), P(), P(), P(), P()),
+            out_specs=P(data_axes),
+            check_vma=False,
+        )(qkv, wq, wk, sin, cos)
+
+    # TP: split q/k/v (GSPMD reshards each slice head-contiguous per the
+    # "heads" rule) and run the split-entry kernel with local head counts
+    c = qkv.shape[-1] // (h + 2 * hkv)
+    q = shard_act(qkv[..., : h * c], "batch", "seq", "heads")
+    k = shard_act(qkv[..., h * c : (h + hkv) * c], "batch", "seq", "kv_heads")
+    v = shard_act(qkv[..., (h + hkv) * c :], "batch", "seq", "kv_heads")
+
+    fn = lambda q_, k_, v_, wq_, wk_, s_, c_: fused_attention(  # noqa: E731
+        q_, k_, v_, wq_, wk_, s_, c_, h // tp, hkv // tp, True, None, None, eps
     )
+    act = P(data_axes, None, "tensor")
     return jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(P(data_axes), P(), P(), P(), P()),
-        out_specs=P(data_axes),
+        in_specs=(act, act, act, P(), P(), P(), P()),
+        out_specs=act,
         check_vma=False,
-    )(qkv, wq, wk, sin, cos)
+    )(q, k, v, wq, wk, sin, cos)
 
 
 @module
@@ -197,28 +221,37 @@ class Attention:
             and (self.dropout_rate == 0.0 or deterministic)
         )
         mesh = current_mesh()
-        mesh_sharded = mesh is not None and (
-            mesh.shape.get("tensor", 1) > 1
-            or mesh.shape.get("sequence", 1) > 1
+        tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+        sp = mesh.shape.get("sequence", 1) if mesh is not None else 1
+        # TP is fine when every shard keeps whole supported heads (each
+        # device runs the split-entry kernel with H/tp, Hkv/tp heads);
+        # SP shards T, which the kernel grid cannot see — ring territory
+        tp_ok = (
+            tp == 1
+            or (
+                self.n_head % tp == 0
+                and self.n_kv_head % tp == 0
+                and supported(
+                    self.n_head // tp, self.n_kv_head // tp, self.head_dim()
+                )
+            )
         )
+        mesh_unsupported = sp > 1 or not tp_ok
         if impl == "fused":
             assert shape_ok, (
                 "attn_impl='fused' requires qk-norm, T % 128 == 0, no "
                 "attention dropout, and a supported head shape "
                 "(C % 128 == 0, or C == 64 with MHA)"
             )
-            assert not mesh_sharded, (
-                "attn_impl='fused' cannot run under a tensor- or "
-                "sequence-sharded mesh (heads/T must stay whole per "
-                "device); use attn_impl='auto' (falls back) or 'ring'"
+            assert not mesh_unsupported, (
+                "attn_impl='fused' cannot run under a sequence-sharded "
+                "mesh, or a tensor sharding that breaks the per-shard "
+                "head shape; use attn_impl='auto' (falls back) or 'ring'"
             )
             return True
         from midgpt_tpu.utils.platform import is_tpu_backend
 
-        if mesh_sharded:
-            # TP shards heads (packed lanes must stay whole) and SP shards
-            # T (the kernel grid assumes the full sequence) — both keep the
-            # unfused path, which has per-axis sharding rules / ring
+        if mesh_unsupported:
             return False
         return shape_ok and is_tpu_backend()
 
@@ -232,10 +265,10 @@ class Attention:
         h, hkv = self.n_head, self.n_kv_head
         with jax.named_scope("fused_attention"):
             qkv = self.wqkv(x)  # [B, T, (H + 2Hkv) C]
-            # packed entry: the kernel reads q/k/v via lane-offset index
-            # maps — no slice copies, no pad+add VJP. The lane dim stays
-            # unsharded here (TP head-sharding would split the packed
-            # q|k|v regions unevenly); TP meshes use the unfused path.
+            # single-device / data-sharded meshes take the packed entry
+            # (lane-offset reads, no slice copies, no pad+add VJP); TP
+            # meshes split q/k/v and run per head shard — both inside
+            # _fused_attention_sharded.
             qkv = shard_act(qkv, "batch", "seq", None)
             sin_full = _duplicate_interleaved(jnp.asarray(sin, jnp.float32))
             cos_full = _duplicate_interleaved(jnp.asarray(cos, jnp.float32))
