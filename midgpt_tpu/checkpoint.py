@@ -110,6 +110,14 @@ class Checkpointer:
         items = {k: restored[k] for k in templates}
         return items, dict(restored["meta"])
 
+    def item_metadata(self, step: tp.Optional[int] = None) -> tp.Any:
+        """Shape/dtype metadata of the stored items WITHOUT reading array
+        data — lets callers adapt a config to what a checkpoint actually
+        contains (e.g. pin an MLP width) before building restore templates."""
+        step = step if step is not None else self._mngr.latest_step()
+        assert step is not None, "no checkpoint to inspect"
+        return self._mngr.item_metadata(step)
+
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 

@@ -280,25 +280,11 @@ class Attention:
             out = dropout(out, self.dropout_rate, pdrop_key, deterministic)
             return shard_act(out, "batch", "seq", "embed")
 
-    def decode(
-        self,
-        x: Array,  # [B, 1, D] — one new token per sequence
-        cache_k: Array,  # [B, Hkv, W, C] ring buffer
-        cache_v: Array,  # [B, Hkv, W, C]
-        slot: Array,  # [] int32 — ring slot to write (pos % W)
-        mask: Array,  # [W] f32 additive mask over cache slots (0 / -inf)
-        sin_row: Array,  # [1, C//2] rope row at the token's ABSOLUTE position
-        cos_row: Array,
+    def _decode_qkv(
+        self, x: Array, sin_row: Array, cos_row: Array
     ) -> tp.Tuple[Array, Array, Array]:
-        """Single-token incremental attention against a ring-buffer KV cache.
-
-        The reference has no decode path (sample.py:72-94 re-runs the full
-        forward per token); this is the TPU-native replacement: O(W) per
-        token, static shapes, jit/scan-friendly. Keys are roped at absolute
-        positions, so evicting the oldest slot implements the reference's
-        sliding window (sample.py:74 ``idx[:, -block_size:]``) exactly:
-        attention scores depend only on position DIFFERENCES (RoPE shift
-        invariance, tests/test_layers.py)."""
+        """Project one token's q/k/v (+ optional QK-norm + rope at the
+        token's absolute position). q: [B, H, 1, C]; k/v: [B, Hkv, 1, C]."""
         b, one, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
@@ -314,23 +300,137 @@ class Attention:
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = apply_rotary(q, sin_row, cos_row)
         k = apply_rotary(k, sin_row, cos_row)
-        cache_k = jax.lax.dynamic_update_slice_in_dim(
-            cache_k, k.astype(cache_k.dtype), slot, axis=2
+        return q, k, v
+
+    def decode_at(
+        self,
+        x: Array,  # [B, 1, D] — one new token per sequence
+        cache_k: Array,  # [L, B, Hkv, C, W] FULL stacked ring buffer (time-minor)
+        cache_v: Array,  # [L, B, Hkv, C, W]
+        layer: int,  # STATIC layer index into the stacked cache
+        slot: Array,  # [] int32 — ring slot to write (pos % W)
+        mask: Array,  # [W] f32 additive mask over cache slots (0 / -inf)
+        sin_row: Array,  # [1, C//2] rope row at the token's ABSOLUTE position
+        cos_row: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Single-token incremental attention against a ring-buffer KV cache.
+
+        The reference has no decode path (sample.py:72-94 re-runs the full
+        forward per token); this is the TPU-native replacement: O(W) per
+        token, static shapes, jit/scan-friendly. Keys are roped at absolute
+        positions, so evicting the oldest slot implements the reference's
+        sliding window (sample.py:74 ``idx[:, -block_size:]``) exactly:
+        attention scores depend only on position DIFFERENCES (RoPE shift
+        invariance, tests/test_layers.py).
+
+        Takes the WHOLE stacked cache and a static ``layer``: the write is
+        one [B, Hkv, 1, C] dynamic_update_slice row that XLA aliases in
+        place, and the read is a static slice that fuses into the attention
+        einsums — nothing copies or re-stacks the [L, ...] cache (the old
+        scan-over-layers decode re-materialized all L·B·Hkv·W·C elements of
+        both caches per token: ~300 MB/step at the 124M shape, the dominant
+        term in the measured 2.9 ms/token, PERF.md 'Serving bench')."""
+        b, one, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        q, k, v = self._decode_qkv(x, sin_row, cos_row)
+        # cache is time-minor ([B, Hkv, C, W] per layer — see KVCache): the
+        # new row lands as a single-lane column write
+        kc = jnp.transpose(k, (0, 1, 3, 2))  # [B, Hkv, C, 1]
+        vc = jnp.transpose(v, (0, 1, 3, 2))
+        zero = jnp.zeros((), slot.dtype)
+        at = (jnp.asarray(layer, slot.dtype), zero, zero, zero, slot)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, kc.astype(cache_k.dtype)[None], at
         )
-        cache_v = jax.lax.dynamic_update_slice_in_dim(
-            cache_v, v.astype(cache_v.dtype), slot, axis=2
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, vc.astype(cache_v.dtype)[None], at
         )
+        ck, cv = cache_k[layer], cache_v[layer]  # [B, Hkv, C, W] views
+        # single-query attention as broadcast-multiply + reduce, NOT
+        # dot_general: a [1, C] x [C, W] matvec uses one MXU row per pass
+        # and measured ~160 GB/s; the VPU form streams the cache at full
+        # rate (profiled 1.26 -> ~0.3 ms/step at 124M W=1024, PERF.md r4).
+        # f32 casts fuse into the reduce — nothing materializes at [.., C, W].
         qg = q.reshape(b, hkv, h // hkv, 1, c)
-        scores = jnp.einsum(
-            "bkgqc,bkjc->bkgqj", qg, cache_k, preferred_element_type=jnp.float32
-        )  # [B, Hkv, G, 1, W]
+        qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [B, Hkv, G, C, 1]
+        scores = jnp.sum(
+            qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
+            axis=-2,
+        )  # [B, Hkv, G, W]
         probs = jax.nn.softmax(
             (scores + mask) / math.sqrt(c), axis=-1
-        ).astype(cache_v.dtype)
-        out = jnp.einsum("bkgqj,bkjc->bkgqc", probs, cache_v)
+        )  # [B, Hkv, G, W] f32 — reduces over W must accumulate in f32
+        out = jnp.sum(
+            probs[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
+            axis=-1,
+        ).astype(x.dtype)  # [B, Hkv, G, C]
+        out = out[:, :, :, None, :]  # [B, Hkv, G, 1, C]
         out = out.reshape(b, h, 1, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
         return self.wo(out), cache_k, cache_v
+
+    def decode_recent_at(
+        self,
+        x: Array,  # [B, 1, D]
+        cache_k: Array,  # [L, B, Hkv, C, W] — READ-ONLY within the chunk
+        cache_v: Array,  # [L, B, Hkv, C, W]
+        rk: Array,  # [L, B, Hkv, R, C] recent-K write buffer (row writes)
+        rv: Array,  # [L, B, Hkv, R, C]
+        layer: int,  # STATIC layer index
+        r: Array,  # [] int32 — step index within the chunk (recent row)
+        mask_big: Array,  # [W] additive f32 over merged cache slots
+        mask_rec: Array,  # [R] additive f32 over recent rows
+        sin_row: Array,
+        cos_row: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Two-part single-token attention: merged ring cache + a small
+        write-combining 'recent' buffer.
+
+        Why the split (PERF.md r4 'Serving'): a per-step write into the big
+        time-minor cache is a 1-lane column scattered over ~768 (8,128)
+        tiles — XLA either flips the cache layout to make that write cheap
+        (halving read bandwidth; reads are ~6x the writes) or pays ~24 us
+        of scattered RMW per cache per layer. Writing instead into a small
+        time-MAJOR buffer is one contiguous tile row per (b, kv-head); the
+        big cache stays read-only (keeps its streaming-friendly layout) and
+        absorbs the recent rows in one bulk aligned merge per chunk
+        (``merge_recent``). Softmax runs jointly over both parts — exact,
+        not an approximation."""
+        b, one, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        q, k, v = self._decode_qkv(x, sin_row, cos_row)
+        zero = jnp.zeros((), r.dtype)
+        at = (jnp.asarray(layer, r.dtype), zero, zero, r, zero)
+        rk = jax.lax.dynamic_update_slice(rk, k.astype(rk.dtype)[None], at)
+        rv = jax.lax.dynamic_update_slice(rv, v.astype(rv.dtype)[None], at)
+        ck, cv = cache_k[layer], cache_v[layer]  # [B, Hkv, C, W]
+        rkl, rvl = rk[layer], rv[layer]  # [B, Hkv, R, C]
+        qg = q.reshape(b, hkv, h // hkv, 1, c)
+        qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [B, Hkv, G, C, 1]
+        s_big = jnp.sum(
+            qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
+            axis=-2,
+        )  # [B, Hkv, G, W]
+        s_rec = jnp.sum(
+            qg.astype(jnp.float32) * rkl[:, :, None].astype(jnp.float32),
+            axis=-1,
+        )  # [B, Hkv, G, R]  (qg [.., 1, C] x rkl [.., R, C] summed over C)
+        s = jnp.concatenate([s_big + mask_big, s_rec + mask_rec], axis=-1)
+        probs = jax.nn.softmax(s / math.sqrt(c), axis=-1)  # [B, Hkv, G, W+R]
+        p_big, p_rec = probs[..., : s_big.shape[-1]], probs[..., s_big.shape[-1]:]
+        o_big = jnp.sum(
+            p_big[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
+            axis=-1,
+        )  # [B, Hkv, G, C]
+        o_rec = jnp.sum(
+            p_rec[..., None] * rvl[:, :, None].astype(jnp.float32), axis=-2
+        )  # [B, Hkv, G, C]
+        out = (o_big + o_rec).astype(x.dtype)
+        out = out.reshape(b, h, 1, c)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
+        return self.wo(out), rk, rv
 
 
 def mlp_hidden_dim(cfg: ModelConfig) -> int:
@@ -346,6 +446,46 @@ def mlp_hidden_dim(cfg: ModelConfig) -> int:
     if f == int(f):
         return int(f)
     return 256 * -(-int(f) // 256)
+
+
+def maybe_pin_mlp_hidden(cfg: ModelConfig, stored_params_meta: tp.Any) -> ModelConfig:
+    """Reconcile ``cfg`` with a checkpoint's stored MLP width.
+
+    Checkpoints written before fractional SwiGLU widths rounded up to a
+    multiple of 256 hold ``int(mlp_ratio * n_embd)``-wide tensors; a config
+    with ``mlp_hidden=None`` would now resolve to the rounded width and the
+    restore templates would mismatch. Given the checkpoint's param METADATA
+    (``Checkpointer.item_metadata()[...]["params"]`` — shapes only, no array
+    reads), pin ``cfg.mlp_hidden`` to whatever width the checkpoint actually
+    holds. No-op when the widths already agree or ``mlp_hidden`` is pinned."""
+    import dataclasses
+
+    if cfg.mlp_hidden is not None:
+        return cfg
+    stored = None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stored_params_meta)[0]:
+        keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        if "w_down" in keys:
+            # blocks are layer-stacked: w_down.weight is [L, F, D]
+            stored = int(leaf.shape[-2])
+            break
+    if stored is None or stored == mlp_hidden_dim(cfg):
+        return cfg
+    return dataclasses.replace(cfg, mlp_hidden=stored)
+
+
+def pin_mlp_hidden_from_ckpt(cfg: ModelConfig, ckpt: tp.Any) -> ModelConfig:
+    """The restore-time entry point for ``maybe_pin_mlp_hidden``: no-op
+    unless the width is fractional and unpinned (the only case the
+    256-rounding rule changed), so ordinary restores skip the checkpoint
+    metadata read (and its Orbax handler warnings). ``ckpt`` is anything
+    with ``item_metadata()`` returning a ``{"params": ...}`` metadata tree
+    (midgpt_tpu.checkpoint.Checkpointer)."""
+    if cfg.mlp_hidden is not None:
+        return cfg
+    if cfg.mlp_ratio * cfg.n_embd == int(cfg.mlp_ratio * cfg.n_embd):
+        return cfg
+    return maybe_pin_mlp_hidden(cfg, ckpt.item_metadata()["params"])
 
 
 @module
@@ -438,13 +578,25 @@ class Block:
         x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
         return (x, kv) if return_kv else x
 
-    def decode(self, x, cache_k, cache_v, slot, mask, sin_row, cos_row):
-        attn_out, cache_k, cache_v = self.attn.decode(
-            self.ln1(x), cache_k, cache_v, slot, mask, sin_row, cos_row
+    def decode_at(self, x, cache_k, cache_v, layer, slot, mask, sin_row, cos_row):
+        attn_out, cache_k, cache_v = self.attn.decode_at(
+            self.ln1(x), cache_k, cache_v, layer, slot, mask, sin_row, cos_row
         )
         x = x + attn_out
         x = x + self.mlp(self.ln2(x))
         return x, cache_k, cache_v
+
+    def decode_recent_at(
+        self, x, cache_k, cache_v, rk, rv, layer, r, mask_big, mask_rec,
+        sin_row, cos_row,
+    ):
+        attn_out, rk, rv = self.attn.decode_recent_at(
+            self.ln1(x), cache_k, cache_v, rk, rv, layer, r,
+            mask_big, mask_rec, sin_row, cos_row,
+        )
+        x = x + attn_out
+        x = x + self.mlp(self.ln2(x))
+        return x, rk, rv
 
 
 def embed_tokens(wte: Embedding, tokens: Array) -> Array:
@@ -589,14 +741,23 @@ class GPT:
 @module
 class KVCache:
     """Per-layer KV cache; leaves carry a leading n_layer axis, matching the
-    scan-stacked block params."""
+    scan-stacked block params.
 
-    k: Array  # [L, B, Hkv, T_max, C]
-    v: Array  # [L, B, Hkv, T_max, C]
+    TIME IS THE MINOR DIM ([..., C, W], not [..., W, C]): TPU tiles the last
+    two dims to (8, 128), so a W-major cache with C=64 pads every 64-lane
+    row to 128 — 2x the HBM footprint AND half the effective read bandwidth
+    on the decode path, which is cache-read-bound (measured 1.33 us/slot vs
+    the 0.36 us roofline, PERF.md r4). With W minor the tiles are full:
+    C=64 sublanes are a legal multiple of 8 and W pads only to the next 128.
+    The attention einsums contract identically either way — only the index
+    order changes."""
+
+    k: Array  # [L, B, Hkv, C, T_max]
+    v: Array  # [L, B, Hkv, C, T_max]
 
     @staticmethod
     def init(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> "KVCache":
-        shape = (cfg.n_layer, batch, cfg.kv_heads, max_len, cfg.head_dim)
+        shape = (cfg.n_layer, batch, cfg.kv_heads, cfg.head_dim, max_len)
         return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -615,9 +776,17 @@ def decode_step(
     this is ordinary append-at-pos decoding; past W it becomes the
     reference's sliding window (sample.py:74): the new token evicts the
     oldest. ``rope_len`` sizes the rope tables (>= total generation length;
-    defaults to W for the non-sliding case)."""
+    defaults to W for the non-sliding case).
+
+    The layer loop is STRAIGHT-LINE code over static layer slices — not a
+    lax.scan. Scanning the cache through as xs/ys re-stacked every element
+    of both [L, B, Hkv, W, C] caches per token (~300 MB at 124M, ~6x the
+    weights); serving is HBM-bound, so that re-stack dominated the step.
+    Unrolled, each layer is one in-place row write + a static-slice read,
+    the block weights stream exactly once per token, and XLA fuses the
+    whole layer into a handful of kernels."""
     cfg = model.config
-    w = cache.k.shape[3]
+    w = cache.k.shape[-1]
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len or w, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -633,23 +802,85 @@ def decode_step(
     cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)
 
     h = embed_tokens(model.wte, tokens[:, None])  # [B, 1, D]
-
-    def body(carry, layer):
-        x = carry
-        block, ck, cv = layer
-        x, ck, cv = block.decode(
-            x, ck, cv, slot, mask,
-            sin_row.astype(x.dtype), cos_row.astype(x.dtype),
-        )
-        return x, (ck, cv)
-
-    h, (new_k, new_v) = jax.lax.scan(
-        body, h, (model.blocks, cache.k, cache.v),
-        unroll=cfg.scan_unroll if cfg.scan_unroll else cfg.n_layer,
-    )
+    ck, cv = cache.k, cache.v
+    sin_h, cos_h = sin_row.astype(h.dtype), cos_row.astype(h.dtype)
+    for i in range(cfg.n_layer):
+        block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
+        h, ck, cv = block.decode_at(h, ck, cv, i, slot, mask, sin_h, cos_h)
     h = model.ln_f(h)
     logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
-    return logits, KVCache(k=new_k, v=new_v)
+    return logits, KVCache(k=ck, v=cv)
+
+
+def decode_step_recent(
+    model: GPT,
+    tokens: Array,  # [B] int32
+    pos: Array,  # [] int32 — absolute position (chunk_base + r)
+    cache: KVCache,  # merged ring cache, READ-ONLY here
+    rk: Array,  # [L, B, Hkv, R, C] recent-K buffer
+    rv: Array,
+    r: Array,  # [] int32 — step index within the chunk
+    chunk_base: tp.Union[int, Array],  # absolute position of the chunk start
+    window: int,  # STATIC sliding-window size (min(total, block_size))
+    rope_len: int,
+) -> tp.Tuple[Array, Array, Array]:
+    """One decode step of the chunked sampler: attends over the merged ring
+    cache (positions < chunk_base, masked to the sliding window) plus the
+    recent buffer (positions chunk_base..chunk_base+r), and appends this
+    token's K/V to the recent buffer. The big cache is never written — see
+    ``Attention.decode_recent_at`` for why that is the fast shape of KV
+    decoding on TPU. ``merge_recent`` folds the buffer in at chunk end."""
+    cfg = model.config
+    w = cache.k.shape[-1]
+    rr = rk.shape[3]
+    sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
+    sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    # merged slot s holds the latest position < chunk_base congruent to s
+    # (mod W'); valid iff it exists and is inside the sliding window
+    idx = jnp.arange(w)
+    cb1 = chunk_base - 1
+    abs_pos = cb1 - jnp.mod(cb1 - idx, w)
+    valid_big = (abs_pos >= 0) & (abs_pos > pos - window)
+    mask_big = jnp.where(valid_big, 0.0, -jnp.inf).astype(jnp.float32)
+    mask_rec = jnp.where(
+        jnp.arange(rr) <= r, 0.0, -jnp.inf
+    ).astype(jnp.float32)
+    sin_row = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, axis=0)
+    cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)
+
+    h = embed_tokens(model.wte, tokens[:, None])  # [B, 1, D]
+    sin_h, cos_h = sin_row.astype(h.dtype), cos_row.astype(h.dtype)
+    for i in range(cfg.n_layer):
+        block = jax.tree.map(lambda a: a[i], model.blocks)
+        h, rk, rv = block.decode_recent_at(
+            h, cache.k, cache.v, rk, rv, i, r, mask_big, mask_rec,
+            sin_h, cos_h,
+        )
+    h = model.ln_f(h)
+    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
+    return logits, rk, rv
+
+
+def merge_recent(
+    cache: KVCache, rk: Array, rv: Array, slot0: tp.Union[int, Array],
+    length: int,
+) -> KVCache:
+    """Fold the first ``length`` recent rows into the ring cache at slots
+    [slot0, slot0+length) — one bulk, statically-indexed column-block write
+    per cache (the chunked sampler aligns chunk bases so the slot range
+    never wraps). The small transpose relayouts ~R columns once per chunk
+    instead of paying scattered column writes every token."""
+    kc = jnp.transpose(rk[:, :, :, :length, :], (0, 1, 2, 4, 3))
+    vc = jnp.transpose(rv[:, :, :, :length, :], (0, 1, 2, 4, 3))
+    return KVCache(
+        k=jax.lax.dynamic_update_slice_in_dim(
+            cache.k, kc.astype(cache.k.dtype), slot0, axis=4
+        ),
+        v=jax.lax.dynamic_update_slice_in_dim(
+            cache.v, vc.astype(cache.v.dtype), slot0, axis=4
+        ),
+    )
 
 
 def prefill(
@@ -663,7 +894,7 @@ def prefill(
     filled cache."""
     cfg = model.config
     b, p = tokens.shape
-    t_max = cache.k.shape[3]
+    t_max = cache.k.shape[-1]
     assert p <= t_max, f"prompt {p} exceeds cache length {t_max}"
     # ring needs a live mesh, and an explicit 'flash' may not divide an
     # arbitrary prompt length — 'auto' keeps the flash fast path for
@@ -673,11 +904,15 @@ def prefill(
     h, (ks, vs) = model.hidden(
         tokens, deterministic=True, attn_impl=impl, return_kv=True
     )  # ks/vs: [L, B, Hkv, P, C]
+    # one-time transpose into the time-minor cache layout (KVCache) —
+    # prefill is compute-bound, the relayout is noise there
+    ks = jnp.transpose(ks, (0, 1, 2, 4, 3))  # [L, B, Hkv, C, P]
+    vs = jnp.transpose(vs, (0, 1, 2, 4, 3))
     cache_k = jax.lax.dynamic_update_slice_in_dim(
-        cache.k, ks.astype(cache.k.dtype), 0, axis=3
+        cache.k, ks.astype(cache.k.dtype), 0, axis=4
     )
     cache_v = jax.lax.dynamic_update_slice_in_dim(
-        cache.v, vs.astype(cache.v.dtype), 0, axis=3
+        cache.v, vs.astype(cache.v.dtype), 0, axis=4
     )
     logits = (h[:, -1, :] @ model.head_weight(h.dtype))  # [B, V]
     return logits, KVCache(k=cache_k, v=cache_v)

@@ -13,9 +13,38 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
-from midgpt_tpu.models.gpt import GPT, KVCache, decode_step, prefill
+from midgpt_tpu.models.gpt import (
+    GPT,
+    KVCache,
+    decode_step_recent,
+    merge_recent,
+    prefill,
+)
 
 Array = jax.Array
+
+
+def _pin_cache_layout(cache: KVCache) -> KVCache:
+    """Constrain the ring cache to the standard streaming layout (W minor).
+
+    Without this, XLA's layout assignment sees the bulk merge writes and
+    may flip the cache to a write-friendly C-minor layout that pads C=64
+    lanes to 128 — halving read bandwidth on the decode hot loop (measured
+    on v5e, PERF.md r4 'Serving'). Single-device TPU only: under a mesh
+    GSPMD owns layouts, and on CPU it's moot."""
+    if jax.default_backend() != "tpu":
+        return cache
+    from midgpt_tpu.parallel.sharding import current_mesh
+
+    if current_mesh() is not None:
+        return cache
+    from jax.experimental.layout import Layout, with_layout_constraint
+
+    lay = Layout(tuple(range(cache.k.ndim)))
+    return KVCache(
+        k=with_layout_constraint(cache.k, lay),
+        v=with_layout_constraint(cache.v, lay),
+    )
 
 
 def _sample_token(logits: Array, key: Array, temperature: float, top_k: tp.Optional[int]) -> Array:
@@ -40,6 +69,7 @@ def generate(
     top_k: tp.Optional[int] = None,
     cache_dtype=jnp.bfloat16,
     sliding: str = "exact",
+    chunk_len: int = 64,
 ) -> Array:
     """Returns [B, max_new_tokens] sampled continuations (parity:
     sample.py:68-95 generate, temperature semantics sample.py:88-92).
@@ -57,8 +87,14 @@ def generate(
       the hidden states they were computed with (standard sliding-window
       KV decoding, O(W)/token). Diverges from the reference once the
       window slides — fast mode, not a parity mode.
-    """
+
+    Decoding runs in chunks of ``chunk_len`` tokens through a small
+    write-combining recent-KV buffer (gpt.decode_step_recent) so the ring
+    cache stays read-only between bulk merges — the layout-friendly shape
+    of KV decode on TPU (PERF.md r4). The joint softmax over both parts is
+    exact; chunking changes performance, not semantics."""
     assert sliding in ("exact", "kv"), f"unknown sliding mode {sliding!r}"
+    assert chunk_len >= 1
     b, p = prompt.shape
     cfg = model.config
     if p > cfg.block_size:
@@ -66,50 +102,114 @@ def generate(
         prompt = prompt[:, -cfg.block_size :]
         p = cfg.block_size
     total = p + max_new_tokens
-    w = min(total, cfg.block_size)
-    cache = KVCache.init(cfg, b, w, dtype=cache_dtype)
+    w = min(total, cfg.block_size)  # sliding-window size (semantics)
+    r_len = chunk_len
+    wp = -(-w // r_len) * r_len  # ring slots, padded so merges never wrap
+    cache = KVCache.init(cfg, b, wp, dtype=cache_dtype)
     logits, cache = prefill(model, prompt, cache)
+    cache = _pin_cache_layout(cache)
 
-    def body(carry, _):
-        logits, pos, cache, k = carry
-        k, sub = jax.random.split(k)
-        tok = _sample_token(logits, sub, temperature, top_k)
-        new_logits, cache = decode_step(model, tok, pos, cache, rope_len=total)
-        return (new_logits, pos + 1, cache, k), tok
+    rshape = (cfg.n_layer, b, cfg.kv_heads, r_len, cfg.head_dim)
+
+    def one_chunk(logits, key, cache, base, clen: int):
+        """clen decode steps from traced base; returns toks [clen, B].
+        base is a TRACED scalar so every full-length chunk shares one
+        compiled body (baking it in statically made trace/compile size grow
+        linearly with max_new_tokens/chunk_len)."""
+        rk = jnp.zeros(rshape, cache.k.dtype)
+        rv = jnp.zeros(rshape, cache.k.dtype)
+
+        def body(carry, _):
+            logits, r, rk, rv, k = carry
+            k, sub = jax.random.split(k)
+            tok = _sample_token(logits, sub, temperature, top_k)
+            new_logits, rk, rv = decode_step_recent(
+                model, tok, base + r, cache, rk, rv, r, base, w, total
+            )
+            return (new_logits, r + 1, rk, rv, k), tok
+
+        (logits, _, rk, rv, key), toks = jax.lax.scan(
+            body,
+            (logits, jnp.zeros((), jnp.int32), rk, rv, key),
+            None,
+            length=clen,
+        )
+        cache = merge_recent(cache, rk, rv, jnp.mod(base, wp), clen)
+        return logits, key, _pin_cache_layout(cache), toks
+
+    def run_chunked(logits, key, cache, start_pos: int, n_steps: int):
+        """n_steps of chunked decode from absolute position start_pos.
+        A partial first chunk aligns subsequent bases to r_len (merges
+        never wrap the ring); the full chunks run under ONE outer scan."""
+        toks_parts = []
+        base, remaining = start_pos, n_steps
+        l0 = min(r_len - base % r_len, remaining) if base % r_len else 0
+        if l0:
+            logits, key, cache, t0 = one_chunk(
+                logits, key, cache, jnp.asarray(base, jnp.int32), l0
+            )
+            toks_parts.append(t0)
+            base, remaining = base + l0, remaining - l0
+        n_full = remaining // r_len
+        if n_full:
+            def chunk_body(carry, _):
+                logits, key, cache, cur = carry
+                logits, key, cache, toks = one_chunk(
+                    logits, key, cache, cur, r_len
+                )
+                return (logits, key, cache, cur + r_len), toks
+
+            (logits, key, cache, _), tf = jax.lax.scan(
+                chunk_body,
+                (logits, key, cache, jnp.asarray(base, jnp.int32)),
+                None,
+                length=n_full,
+            )
+            toks_parts.append(tf.reshape(n_full * r_len, b))
+            base, remaining = base + n_full * r_len, remaining - n_full * r_len
+        if remaining:
+            logits, key, cache, t2 = one_chunk(
+                logits, key, cache, jnp.asarray(base, jnp.int32), remaining
+            )
+            toks_parts.append(t2)
+        toks = (
+            jnp.concatenate(toks_parts, axis=0)
+            if toks_parts
+            else jnp.zeros((0, b), jnp.int32)
+        )
+        return logits, key, cache, toks
 
     n1 = w - p  # tokens decodable before the window would slide
-    (logits, _, cache, key), toks1 = jax.lax.scan(
-        body, (logits, jnp.asarray(p, jnp.int32), cache, key), None, length=n1
-    )
+    if sliding == "kv":
+        # ring eviction is just the sliding-window mask in the chunked
+        # step — one unified loop over all new tokens
+        _, _, _, toks = run_chunked(logits, key, cache, p, max_new_tokens)
+        return jnp.transpose(toks)  # [B, max_new_tokens]
+
+    logits, key, cache, toks1 = run_chunked(logits, key, cache, p, n1)
     toks1 = jnp.transpose(toks1)  # [B, n1]
     if total <= w:
         return toks1
 
+    # exact sliding: re-run the cropped-window full forward per token
     n2 = total - w
-    if sliding == "kv":
-        # same decode body; pos continues from w, evicting the oldest slot
-        (_, _, _, _), toks2 = jax.lax.scan(
-            body, (logits, jnp.asarray(w, jnp.int32), cache, key), None,
-            length=n2,
-        )
-    else:  # exact
-        window = jnp.concatenate([prompt, toks1], axis=1)  # [B, W]
-        # single-chip full forward: ring needs a live mesh and an explicit
-        # 'flash' may not divide W — same impl fallback prefill uses
-        # (models/gpt.py prefill)
-        impl = "auto" if cfg.attn_impl in ("ring", "flash", "fused") else cfg.attn_impl
+    window = jnp.concatenate([prompt, toks1], axis=1)  # [B, W]
+    # single-chip full forward: ring needs a live mesh and an explicit
+    # 'flash' may not divide W — same impl fallback prefill uses
+    # (models/gpt.py prefill)
+    impl = "auto" if cfg.attn_impl in ("ring", "flash", "fused") else cfg.attn_impl
 
-        def body2(carry, _):
-            logits, window, k = carry
-            k, sub = jax.random.split(k)
-            tok = _sample_token(logits, sub, temperature, top_k)
-            window = jnp.concatenate([window[:, 1:], tok[:, None]], axis=1)
-            new_logits = model(window, attn_impl=impl)[:, -1, :]
-            return (new_logits, window, k), tok
+    def body2(carry, _):
+        logits, window, k = carry
+        k, sub = jax.random.split(k)
+        tok = _sample_token(logits, sub, temperature, top_k)
+        window = jnp.concatenate([window[:, 1:], tok[:, None]], axis=1)
+        new_logits = model(window, attn_impl=impl)[:, -1, :]
+        return (new_logits, window, k), tok
 
-        (_, _, _), toks2 = jax.lax.scan(
-            body2, (logits, window, key), None, length=n2
-        )
+    (_, _, _), toks2 = jax.lax.scan(
+        body2, (logits, window, key), None, length=n2
+    )
     return jnp.concatenate([toks1, jnp.transpose(toks2)], axis=1)
 
 
@@ -121,6 +221,7 @@ def make_sampler(
     top_k: tp.Optional[int] = None,
     cache_dtype=jnp.bfloat16,
     sliding: str = "exact",
+    chunk_len: int = 64,
 ):
     """A jitted ``(model, prompt, key) -> tokens`` sampler.
 
@@ -142,6 +243,7 @@ def make_sampler(
                 top_k=top_k,
                 cache_dtype=cache_dtype,
                 sliding=sliding,
+                chunk_len=chunk_len,
             )
 
     return jax.jit(fn)

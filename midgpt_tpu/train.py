@@ -442,13 +442,38 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             async_save=not cfg.debug,
         )
         logger = MetricLogger(cfg.rundir, cfg, use_wandb=cfg.use_wandb)
+        if ckpt.latest_step() is not None:
+            # adapt to the checkpoint's actual MLP width BEFORE building any
+            # state: configs with mlp_hidden=None saved under the old
+            # fractional-width rule would otherwise resolve to the rounded
+            # width and fail restore with a shape mismatch (ADVICE r3)
+            from midgpt_tpu.models.gpt import pin_mlp_hidden_from_ckpt
+
+            pinned = pin_mlp_hidden_from_ckpt(cfg.model, ckpt)
+            if pinned is not cfg.model and proc == 0:
+                print(f"restore: pinned mlp_hidden={pinned.mlp_hidden} "
+                      "to match the checkpoint's stored width")
+            cfg = dataclasses.replace(cfg, model=pinned)
         # fingerprint covers only fields that change the math/parameters —
         # runtime implementation knobs (kernel choice, remat, unroll) may vary
-        # freely between save and resume
+        # freely between save and resume; mlp_hidden is normalized to the
+        # RESOLVED width so a pinned width and a ratio resolving to the same
+        # width fingerprint identically. Checkpoints saved before the
+        # normalization hashed the RAW mlp_hidden (usually None) — those
+        # hashes are accepted on restore so old runs still resume.
+        from midgpt_tpu.models.gpt import mlp_hidden_dim
+
         _impl_knobs = ("attn_impl", "norm_impl", "remat", "scan_unroll")
-        fingerprint = config_fingerprint(
-            {k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs}
-        )
+        _fp_dict = {
+            k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs
+        }
+        _fp_dict["mlp_hidden"] = mlp_hidden_dim(cfg.model)
+        fingerprint = config_fingerprint(_fp_dict)
+        accepted_fingerprints = {fingerprint}
+        for legacy_mh in {None, cfg.model.mlp_hidden}:
+            accepted_fingerprints.add(
+                config_fingerprint({**_fp_dict, "mlp_hidden": legacy_mh})
+            )
 
         key = jax.random.PRNGKey(cfg.seed)
         state = init_state(cfg, mesh, tx, key)
@@ -464,7 +489,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 opt_state=items["opt_state"],
                 step=items["extra"]["step"],
             )
-            assert meta.get("model_fingerprint") == fingerprint, (
+            assert meta.get("model_fingerprint") in accepted_fingerprints, (
                 "checkpoint was trained with a different model config"
             )
             train_loader.load_state_dict(meta["loader"])

@@ -33,9 +33,12 @@ def get_tokenizer(data_dir: str):
         with open(meta_path, "rb") as f:
             meta = pickle.load(f)
         stoi, itos = meta["stoi"], meta["itos"]
+        # models whose vocab_size exceeds the charset (padded for MXU/TP
+        # alignment) can emit unmapped ids when undertrained — render those
+        # as U+FFFD instead of crashing the CLI
         return (
             lambda s: [stoi[c] for c in s],
-            lambda ids: "".join(itos[int(i)] for i in ids),
+            lambda ids: "".join(itos.get(int(i), "�") for i in ids),
         )
     try:
         import tiktoken
@@ -74,6 +77,15 @@ def main() -> None:
 
     cfg = load_run_config(args.ckpt_dir)
 
+    ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
+    # pre-256-rounding checkpoints hold the legacy fractional SwiGLU width —
+    # pin to whatever the checkpoint actually stores (no-op otherwise)
+    import dataclasses
+
+    from midgpt_tpu.models.gpt import pin_mlp_hidden_from_ckpt
+
+    cfg = dataclasses.replace(cfg, model=pin_mlp_hidden_from_ckpt(cfg.model, ckpt))
+
     # params-only restore: checkpoints store params / opt_state as separate
     # items, so sampling never materializes Adam moments (the reference
     # rebuilds a dummy optimizer just to match the tree, sample.py:111-131)
@@ -104,7 +116,6 @@ def main() -> None:
                 shardings,
             )
 
-    ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
     items, meta = ckpt.restore({"params": abstract_params})
     print(f"restored step {meta['step']} from {args.ckpt_dir}")
     model = items["params"]

@@ -80,6 +80,46 @@ def test_restore_across_mesh_change(saved_mesh_a):
     ckpt.close()
 
 
+def test_restore_pins_legacy_mlp_width(tmp_path):
+    """A SwiGLU checkpoint holding the legacy int(ratio*D) MLP width must
+    restore into a config with mlp_hidden=None: maybe_pin_mlp_hidden reads
+    the stored shapes (no array data) and pins the width (ADVICE r3 — the
+    256-rounding change would otherwise shape-mismatch every old ckpt)."""
+    import dataclasses
+
+    from midgpt_tpu.models.gpt import GPT, maybe_pin_mlp_hidden, mlp_hidden_dim
+
+    legacy = ModelConfig(
+        block_size=32, vocab_size=128, n_layer=2, n_head=4, n_embd=64,
+        mlp="swiglu", mlp_ratio=8 / 3, mlp_hidden=170,  # int(8/3 * 64)
+    )
+    params = GPT.init(jax.random.PRNGKey(0), legacy)
+    ckpt = Checkpointer(str(tmp_path / "run"), save_interval_steps=1)
+    ckpt.save(0, {"params": params}, meta={"step": 0}, force=True)
+    ckpt.wait()
+
+    new = dataclasses.replace(legacy, mlp_hidden=None)
+    assert mlp_hidden_dim(new) == 256  # would mismatch without the pin
+    pinned = maybe_pin_mlp_hidden(new, ckpt.item_metadata()["params"])
+    assert pinned.mlp_hidden == 170
+    # width already matching -> config returned unchanged
+    assert maybe_pin_mlp_hidden(legacy, ckpt.item_metadata()["params"]) is legacy
+    # the restore-time entry point applies the same pin (and no-ops when
+    # the width is pinned or integral)
+    from midgpt_tpu.models.gpt import pin_mlp_hidden_from_ckpt
+
+    assert pin_mlp_hidden_from_ckpt(new, ckpt).mlp_hidden == 170
+    assert pin_mlp_hidden_from_ckpt(legacy, ckpt) is legacy
+
+    template = jax.eval_shape(lambda: GPT.init(jax.random.PRNGKey(1), pinned))
+    items, _ = ckpt.restore({"params": template})
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(items["params"].blocks.mlp.w_down.weight)),
+        np.asarray(jax.device_get(params.blocks.mlp.w_down.weight)),
+    )
+    ckpt.close()
+
+
 @pytest.mark.slow
 def test_restore_into_pipeline_topology(saved_mesh_a):
     """Save on a plain FSDP mesh, resume on a pipeline-parallel mesh: the

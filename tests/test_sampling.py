@@ -46,9 +46,9 @@ def test_prefill_matches_stepwise():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full[:, -1, :]), atol=2e-4
     )
-    # caches populated only up to the prompt length
-    assert not np.allclose(np.asarray(cache2.k[:, :, :, :8]), 0)
-    np.testing.assert_array_equal(np.asarray(cache2.k[:, :, :, 8:]), 0)
+    # caches populated only up to the prompt length (time-minor layout)
+    assert not np.allclose(np.asarray(cache2.k[..., :8]), 0)
+    np.testing.assert_array_equal(np.asarray(cache2.k[..., 8:]), 0)
 
 
 def test_generate_shapes_and_determinism():
@@ -151,6 +151,104 @@ def test_batched_prefill_matches_stepwise_oracle():
     np.testing.assert_allclose(
         np.asarray(cache_a.v), np.asarray(cache_b.v), atol=2e-5
     )
+
+
+def test_chunked_decode_matches_decode_step_oracle():
+    """Teacher-forced logits parity: the chunked recent-buffer decode path
+    (decode_step_recent + merge_recent, the serving hot path) must match
+    the per-token decode_step oracle at every position — including across
+    chunk merges, ring wrap, and sliding-window eviction."""
+    from midgpt_tpu.models.gpt import decode_step_recent, merge_recent
+
+    model = GPT.init(jax.random.PRNGKey(0), CFG)
+    p, n_steps, r_len = 5, 17, 4
+    window = 16  # < p + n_steps -> sliding kicks in
+    total = p + n_steps
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (2, total), 0, CFG.vocab_size
+    )
+
+    # oracle: plain ring decode at exactly `window` slots
+    cache_o = KVCache.init(CFG, batch=2, max_len=window, dtype=jnp.float32)
+    _, cache_o = prefill(model, tokens[:, :p], cache_o)
+    oracle = []
+    for t in range(p, total):
+        lo, cache_o = decode_step(
+            model, tokens[:, t], jnp.asarray(t, jnp.int32), cache_o,
+            rope_len=total,
+        )
+        oracle.append(np.asarray(lo))
+
+    # chunked: padded ring + recent buffers, merged every r_len steps
+    wp = -(-window // r_len) * r_len
+    cache = KVCache.init(CFG, batch=2, max_len=wp, dtype=jnp.float32)
+    _, cache = prefill(model, tokens[:, :p], cache)
+    got = []
+    base = p
+    while base < total:
+        clen = min(r_len - base % r_len, total - base)
+        rshape = (CFG.n_layer, 2, CFG.kv_heads, r_len, CFG.head_dim)
+        rk = jnp.zeros(rshape, jnp.float32)
+        rv = jnp.zeros(rshape, jnp.float32)
+        for r in range(clen):
+            t = base + r
+            lg, rk, rv = decode_step_recent(
+                model, tokens[:, t], jnp.asarray(t, jnp.int32), cache,
+                rk, rv, jnp.asarray(r, jnp.int32), base, window, total,
+            )
+            got.append(np.asarray(lg))
+        cache = merge_recent(cache, rk, rv, base % wp, clen)
+        base += clen
+
+    for i, (a, b) in enumerate(zip(oracle, got)):
+        np.testing.assert_allclose(
+            a, b, atol=2e-4, err_msg=f"step {i} (pos {p + i})"
+        )
+
+
+def test_generate_chunk_len_invariance():
+    """Sampled tokens must not depend on the chunk length (greedy)."""
+    model = GPT.init(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 5), 0, CFG.vocab_size)
+    outs = [
+        np.asarray(
+            generate(
+                model, prompt, 10, key=jax.random.PRNGKey(1),
+                temperature=0.0, cache_dtype=jnp.float32, chunk_len=cl,
+            )
+        )
+        for cl in (1, 3, 64)
+    ]
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], outs[2])
+
+
+def test_generate_kv_sliding_chunked_matches_oracle():
+    """sliding='kv' generation (chunked ring + eviction) vs a manual greedy
+    rollout through the decode_step oracle ring."""
+    cfg_small = dataclasses.replace(CFG, block_size=12)  # slides early
+    model = GPT.init(jax.random.PRNGKey(0), cfg_small)
+    p, n = 6, 14  # total 20 > block 12 -> slides
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (1, p), 0, cfg_small.vocab_size)
+    out = generate(
+        model, prompt, n, key=jax.random.PRNGKey(0), temperature=0.0,
+        cache_dtype=jnp.float32, sliding="kv", chunk_len=4,
+    )
+
+    w = cfg_small.block_size
+    cache = KVCache.init(cfg_small, 1, w, dtype=jnp.float32)
+    logits, cache = prefill(model, prompt, cache)
+    toks = []
+    pos = p
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    for _ in range(n):
+        toks.append(int(tok[0]))
+        logits, cache = decode_step(
+            model, tok, jnp.asarray(pos, jnp.int32), cache, rope_len=p + n
+        )
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        pos += 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(toks))
 
 
 def test_generate_flash_configured_unaligned_prompt(pallas_interpret):
