@@ -223,6 +223,7 @@ class Attention:
         mesh = current_mesh()
         tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
         sp = mesh.shape.get("sequence", 1) if mesh is not None else 1
+        pp = mesh.shape.get("pipeline", 1) if mesh is not None else 1
         # TP is fine when every shard keeps whole supported heads (each
         # device runs the split-entry kernel with H/tp, Hkv/tp heads);
         # SP shards T, which the kernel grid cannot see — ring territory
@@ -236,7 +237,11 @@ class Attention:
                 )
             )
         )
-        mesh_unsupported = sp > 1 or not tp_ok
+        # pipeline: the stages already run inside a shard_map over
+        # 'pipeline'; _fused_attention_sharded's in_specs would declare the
+        # activations replicated over that axis and force GSPMD to regather
+        # them (ADVICE r3) — the flash/naive path handles PP meshes
+        mesh_unsupported = sp > 1 or pp > 1 or not tp_ok
         if impl == "fused":
             assert shape_ok, (
                 "attn_impl='fused' requires qk-norm, T % 128 == 0, no "
@@ -843,8 +848,12 @@ def decode_step_recent(
     abs_pos = cb1 - jnp.mod(cb1 - idx, w)
     valid_big = (abs_pos >= 0) & (abs_pos > pos - window)
     mask_big = jnp.where(valid_big, 0.0, -jnp.inf).astype(jnp.float32)
+    # recent row j holds position chunk_base + j: causal upper bound
+    # (j <= r) AND the sliding-window lower bound (j > r - window) — a
+    # chunk longer than the window must evict its own oldest rows too
+    ridx = jnp.arange(rr)
     mask_rec = jnp.where(
-        jnp.arange(rr) <= r, 0.0, -jnp.inf
+        (ridx <= r) & (ridx > r - window), 0.0, -jnp.inf
     ).astype(jnp.float32)
     sin_row = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, axis=0)
     cos_row = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, axis=0)

@@ -6,22 +6,28 @@ The reference (and our dense path) computes all logits, casts to f32, and
 calls softmax xent (/root/reference/src/train.py:76-77) — at B=16, T=1024,
 V=50304 that is a 3.3 GB f32 intermediate, and it is what makes
 remat='none' infeasible at the 124M bench config. Here a ``lax.scan`` over
-T-chunks computes ``[B, tc, V]`` logits per step inside a
-``jax.checkpoint`` body (recomputed in the backward), reducing peak loss
-memory by T/tc while keeping the math bit-identical in structure: logits
-in f32, logsumexp-minus-target-logit, mean over all tokens.
+T-chunks computes the chunk's logits per step inside a ``jax.checkpoint``
+body (recomputed in the backward), reducing peak loss memory by T/tc while
+keeping the math bit-identical in structure: logits in f32,
+logsumexp-minus-target-logit, mean over all tokens.
 
-Sharding note: the scan iterates over the T axis, so this path requires
-the sequence axis to be UNSHARDED (callers gate on mesh['sequence'] == 1;
-under sequence parallelism per-step slicing of a sharded axis would insert
-collectives every chunk). Batch and vocab sharding compose fine — the
-per-chunk matmul + logsumexp reduce over a tensor-sharded V become a
-partial matmul + psum under GSPMD exactly like the dense path.
+Sharding: batch and vocab sharding compose directly — the per-chunk
+matmul + logsumexp reduce over a tensor-sharded V become a partial matmul
++ psum under GSPMD exactly like the dense path. A SHARDED sequence axis
+(ring attention's long-context configs — where the [B, T, V] saving
+matters most) composes too (VERDICT r3 Missing #4: the old gate fell back
+to dense [B, T, V] logits exactly when T was largest): T is reshaped to
+[S, T/S] with the sharded part OUTER, and the scan chunks the INNER,
+unsharded part — every device scans its local tokens in lockstep, purely
+under GSPMD. (A partial-manual shard_map variant hit an XLA CPU
+compiler crash on this pin — bf16 boundary psums lower to an all-reduce
+whose region root is a sharding_constraint, which AllReducePromotion
+cannot clone; the reshape form never creates manual collectives.)
 """
 
 from __future__ import annotations
 
-import functools
+import math
 import typing as tp
 
 import jax
@@ -44,38 +50,61 @@ def chunked_softmax_xent(
     ``unroll`` is forwarded to the chunk ``lax.scan``: profiling the
     flagship shape (PERF.md r2) showed the rolled loop's while overhead —
     the carried [D, V] dW buffer re-read/written every backward iteration
-    and the serialized chunk matmuls — costs more than the [B, tc, V]
-    working set saves; unrolling keeps the memory bound (each chunk's
-    logits are still checkpointed) while letting XLA overlap chunks."""
-    b, t, d = h.shape
-    assert t % chunk_t == 0, f"T={t} not divisible by chunk_t={chunk_t}"
-    nc = t // chunk_t
-    # [nc, B, tc, ...] so scan slices the leading (iteration) axis
-    h_c = jnp.moveaxis(h.reshape(b, nc, chunk_t, d), 1, 0)
-    y_c = jnp.moveaxis(targets.reshape(b, nc, chunk_t), 1, 0)
-
+    and the serialized chunk matmuls — costs more than the per-chunk
+    logits working set saves; unrolling keeps the memory bound (each
+    chunk's logits are still checkpointed) while letting XLA overlap
+    chunks."""
     from midgpt_tpu.parallel.sharding import current_mesh
 
+    b, t, d = h.shape
     mesh = current_mesh()
-    vocab_sharded = mesh is not None and dict(mesh.shape).get("tensor", 1) > 1
+    shape = dict(mesh.shape) if mesh is not None else {}
+    vocab_sharded = shape.get("tensor", 1) > 1
+    sp = shape.get("sequence", 1)
+
+    t_local = t // sp
+    if sp > 1:
+        # per-shard chunk: keep the configured size when it divides the
+        # local T, else the largest common divisor (>=1 always divides)
+        ct = chunk_t if t_local % chunk_t == 0 else math.gcd(t_local, chunk_t)
+    else:
+        assert t % chunk_t == 0, f"T={t} not divisible by chunk_t={chunk_t}"
+        ct = chunk_t
+    nc = t_local // ct
+
+    # [B, T, D] -> [nc, B, S, ct, D]: the sharded part of T (if any) stays
+    # OUTER where the sharding propagates; the scan slices the inner,
+    # unsharded chunk axis — no per-step collectives, no manual psum
+    h_c = jnp.moveaxis(h.reshape(b, sp, nc, ct, d), 2, 0)
+    y_c = jnp.moveaxis(targets.reshape(b, sp, nc, ct), 2, 0)
+    if sp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, ("replica", "fsdp"), "sequence", None, None)
+        h_c = jax.lax.with_sharding_constraint(
+            h_c, jax.sharding.NamedSharding(mesh, spec)
+        )
+        y_c = jax.lax.with_sharding_constraint(
+            y_c, jax.sharding.NamedSharding(mesh, P(*spec[:-1]))
+        )
 
     @jax.checkpoint
     def body(acc, xs):
-        h_i, y_i = xs  # [B, tc, D], [B, tc]
-        z = (h_i @ head_w).astype(jnp.float32)  # [B, tc, V]
-        lse = jax.scipy.special.logsumexp(z, axis=-1)  # [B, tc]
+        h_i, y_i = xs  # [B, S, ct, D], [B, S, ct]
+        z = (h_i @ head_w).astype(jnp.float32)  # [B, S, ct, V]
+        lse = jax.scipy.special.logsumexp(z, axis=-1)
         if vocab_sharded:
             # target logit via a masked reduce, not take_along_axis: a
             # gather whose indexed dim is tensor-sharded would force SPMD
             # involuntary rematerialization (same reason as
             # models.gpt.embed_tokens)
-            vocab_ids = jnp.arange(z.shape[-1])[None, None, :]
+            vocab_ids = jnp.arange(z.shape[-1])
             z_y = jnp.sum(
                 jnp.where(vocab_ids == y_i[..., None], z, 0.0), axis=-1
             )
         else:
             # unsharded vocab: a plain gather reads one element per token
-            # instead of re-reading the whole [B, tc, V] block
+            # instead of re-reading the whole per-chunk logits block
             z_y = jnp.take_along_axis(z, y_i[..., None], axis=-1)[..., 0]
         return acc + jnp.sum(lse - z_y), None
 

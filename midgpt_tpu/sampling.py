@@ -103,7 +103,9 @@ def generate(
         p = cfg.block_size
     total = p + max_new_tokens
     w = min(total, cfg.block_size)  # sliding-window size (semantics)
-    r_len = chunk_len
+    # a chunk longer than the window wastes recent-buffer reads (its
+    # oldest rows are evicted mid-chunk; decode_step_recent masks them)
+    r_len = min(chunk_len, w)
     wp = -(-w // r_len) * r_len  # ring slots, padded so merges never wrap
     cache = KVCache.init(cfg, b, wp, dtype=cache_dtype)
     logits, cache = prefill(model, prompt, cache)

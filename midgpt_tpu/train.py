@@ -127,13 +127,13 @@ def loss_fn(
 
 
 def _effective_loss_chunk(cfg: ExperimentConfig, mesh) -> tp.Optional[int]:
-    """cfg.loss_chunk, disabled when it can't apply: a sharded sequence
-    axis (the chunk scan would slice a sharded dim every step) or a T not
-    divisible by the chunk."""
+    """cfg.loss_chunk, disabled only when T doesn't divide by the chunk.
+    A sharded sequence axis no longer disables chunking: the loss runs the
+    chunk scan per sequence shard under a partial-manual shard_map
+    (ops/loss.py) — the ring/long-context configs are exactly where the
+    [B, T, V] f32 logits the chunking avoids are biggest."""
     chunk = cfg.loss_chunk
     if chunk is None:
-        return None
-    if mesh is not None and dict(mesh.shape).get("sequence", 1) > 1:
         return None
     if cfg.model.block_size % chunk != 0:
         return None

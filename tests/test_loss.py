@@ -64,9 +64,10 @@ def test_loss_fn_chunked_matches_dense_through_model():
 
 
 def test_train_step_with_loss_chunk_sharded(mesh8):
-    """One sharded train step with loss_chunk on vs off: same loss, same
-    updated params (the chunk gate must also auto-disable under a sharded
-    sequence axis without changing results)."""
+    """One sharded train step with loss_chunk on vs off: same loss. The
+    first mesh has sequence=2, so this drives the per-shard chunked path
+    (partial-manual shard_map over 'sequence', ops/loss.py) against dense
+    through the FULL train step on a DP x SP x TP mesh."""
     from jax.sharding import PartitionSpec as P
 
     from midgpt_tpu.parallel.sharding import make_global_array
@@ -96,8 +97,6 @@ def test_train_step_with_loss_chunk_sharded(mesh8):
         yg = make_global_array(y, mesh, spec)
         state, loss = step(state, xg, yg, jax.random.PRNGKey(1))
         losses[name] = float(loss)
-    # sequence axis is sharded (2), so the gate falls back to dense — the
-    # two runs must be identical
     np.testing.assert_allclose(losses["chunked"], losses["dense"], rtol=1e-6)
 
     # now with an unsharded sequence axis the chunked path actually runs
@@ -121,3 +120,35 @@ def test_train_step_with_loss_chunk_sharded(mesh8):
     np.testing.assert_allclose(
         losses["chunked"], losses["dense"], rtol=2e-5
     )
+
+
+def test_chunked_xent_sequence_sharded_values_and_grads(mesh8):
+    """chunked_softmax_xent under a sequence-sharded mesh (the shard_map
+    path) vs the dense oracle: values and h/w grads must match — including
+    a chunk_t that does NOT divide the local T/S (gcd fallback)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from midgpt_tpu.parallel.sharding import axis_rules
+
+    key = jax.random.PRNGKey(4)
+    k1, k2, k3 = jax.random.split(key, 3)
+    h = jax.random.normal(k1, (4, 64, 32))
+    w = jax.random.normal(k2, (32, 96)) * 0.2
+    y = jax.random.randint(k3, (4, 64), 0, 96)
+
+    ref = float(_dense(h, w, y))
+    g_ref = jax.grad(lambda h, w: _dense(h, w, y), argnums=(0, 1))(h, w)
+
+    hs = jax.device_put(h, NamedSharding(mesh8, P(("replica", "fsdp"), "sequence")))
+    ys = jax.device_put(y, NamedSharding(mesh8, P(("replica", "fsdp"), "sequence")))
+
+    for chunk in (16, 32, 48):  # 48 does not divide T/S=32 -> gcd 16
+        def loss(h_, w_):
+            with axis_rules(mesh8):
+                return chunked_softmax_xent(h_, w_, ys, chunk_t=chunk)
+
+        got = jax.jit(loss)(hs, w)
+        np.testing.assert_allclose(float(got), ref, rtol=1e-6)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1)))(hs, w)
+        np.testing.assert_allclose(np.asarray(g[0]), np.asarray(g_ref[0]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g[1]), np.asarray(g_ref[1]), atol=1e-5)

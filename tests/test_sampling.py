@@ -153,7 +153,18 @@ def test_batched_prefill_matches_stepwise_oracle():
     )
 
 
-def test_chunked_decode_matches_decode_step_oracle():
+import pytest
+
+
+@pytest.mark.parametrize(
+    "r_len,window",
+    [
+        (4, 16),  # normal: chunks shorter than the window
+        (16, 8),  # chunk LONGER than the window: recent rows must evict
+                  # mid-chunk too (r4 review finding — mask_rec lower bound)
+    ],
+)
+def test_chunked_decode_matches_decode_step_oracle(r_len, window):
     """Teacher-forced logits parity: the chunked recent-buffer decode path
     (decode_step_recent + merge_recent, the serving hot path) must match
     the per-token decode_step oracle at every position — including across
@@ -161,8 +172,7 @@ def test_chunked_decode_matches_decode_step_oracle():
     from midgpt_tpu.models.gpt import decode_step_recent, merge_recent
 
     model = GPT.init(jax.random.PRNGKey(0), CFG)
-    p, n_steps, r_len = 5, 17, 4
-    window = 16  # < p + n_steps -> sliding kicks in
+    p, n_steps = 5, 17
     total = p + n_steps
     tokens = jax.random.randint(
         jax.random.PRNGKey(4), (2, total), 0, CFG.vocab_size
