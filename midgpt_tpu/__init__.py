@@ -1,10 +1,24 @@
 """midgpt_tpu — a TPU-native LLM pretraining framework.
 
 Capability parity with AllanYangZhou/midGPT (reference at /root/reference),
-rebuilt TPU-first: batched-native models, a 4-axis
-(replica, fsdp, sequence, tensor) device mesh with declarative sharding
-rules, and Pallas flash-attention kernels. (Planned, tracked in SURVEY.md 7:
-ring attention, trainer + async Orbax checkpointing, KV-cached sampler.)
+rebuilt TPU-first:
+
+- batched-native GPT/Llama-family models (``models/``) with GQA, SwiGLU,
+  QK-LN + RoPE, scan-over-layers and remat policies;
+- Pallas kernels (``ops/``): flash attention (custom VJP, GQA, in-kernel
+  attention dropout), the projection-natural fused QK-LN+RoPE+attention
+  family, fused RMSNorm, chunked cross-entropy;
+- a 5-axis (pipeline, replica, fsdp, sequence, tensor) device mesh with
+  declarative sharding rules (``parallel/``), ring attention for sequence
+  parallelism, GPipe pipeline parallelism, and multi-slice DCN layouts;
+- the training engine (``train.py``): donated jitted step, grad
+  accumulation, async Orbax checkpointing with mesh-migration restore,
+  SIGTERM force-save, metrics/MFU logging;
+- serving (``sampling.py``): batched prefill + chunked KV-cache decode
+  with a write-combining recent buffer, multi-chip samplers.
+
+Entry points: ``launch.py`` (training CLI), ``sample.py`` (generation),
+``bench.py`` (benchmarks); see PARITY.md for the reference-parity map.
 """
 
 from midgpt_tpu.config import (

@@ -91,6 +91,13 @@ class MeshConfig:
     # microbatches streamed through the pipeline per step (GPipe bubble =
     # (S-1)/(M+S-1)); 0 = auto (2 * pipeline stages)
     pp_microbatches: int = 0
+    # dtype of activations crossing stage boundaries (inter-stage ppermute
+    # + shard_map boundary). "float32" (default) works everywhere; a bf16
+    # boundary halves ppermute bytes but crashes XLA CPU's
+    # AllReducePromotion pass on the current pin (diagnosed r4: a bf16
+    # manual-boundary all-reduce whose region root is a sharding
+    # constraint cannot be cloned) — try "bfloat16" on real TPU hardware.
+    pp_boundary_dtype: str = "float32"
 
     @property
     def axis_names(self) -> tp.Tuple[str, ...]:
@@ -137,6 +144,11 @@ class ExperimentConfig:
     independent_wd: bool = True  # add_decayed_weights(wd / lr) (train.py:156)
     eval_interval: int = 1000
     eval_batches: int = 200  # (train.py:110)
+    # True: evaluate the SAME held-out batch sweep every interval (the
+    # counter-based loader makes this free) — comparable, low-noise curves
+    # for long runs. False (default) = reference parity: fresh random eval
+    # batches each interval (train.py:110-116)
+    eval_fixed: bool = False
     log_interval: int = 20  # wandb loss logging cadence (train.py:212)
     ckpt_interval: tp.Optional[int] = None  # None => eval_interval (train.py:143)
     ckpt_keep: int = 1  # max_to_keep (train.py:141)

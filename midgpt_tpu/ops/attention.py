@@ -73,21 +73,27 @@ def resolve_impl(
     deterministic: bool = True,
 ) -> str:
     """Resolve "auto" to a concrete implementation: flash on TPU when the
-    sequence tiles (T % 128 == 0) and no attention dropout, else naive."""
+    sequence tiles (T % 128 == 0), else naive. Attention dropout no longer
+    forces naive — the flash kernels regenerate a counter-based mask
+    in-kernel (ops/flash.flash_attention_dropout), so the shakespeare_char
+    family (the reference's only dropout config, model.py:78) trains on the
+    kernel path too."""
     if impl != "auto":
         return impl
     from midgpt_tpu.utils.platform import is_tpu_backend
 
     use_flash = (
         is_tpu_backend()
-        and (dropout_rate == 0.0 or deterministic)
         and seq_len >= 128
         and seq_len % 128 == 0
     )
     return "flash" if use_flash else "naive"
 
 
-def _flash_sharded(q: Array, k: Array, v: Array, causal: bool):
+def _flash_sharded(
+    q: Array, k: Array, v: Array, causal: bool,
+    dropout_rate: float = 0.0, seed: tp.Optional[Array] = None,
+):
     """shard_map wrapper for the flash kernel under a live data/TP mesh.
 
     A bare ``pallas_call`` is an opaque custom call — with batch- or
@@ -116,9 +122,32 @@ def _flash_sharded(q: Array, k: Array, v: Array, causal: bool):
         return None
     from jax.sharding import PartitionSpec as P
 
-    from midgpt_tpu.ops.flash import flash_attention
+    from midgpt_tpu.ops.flash import flash_attention, flash_attention_dropout
 
     spec = P(("replica", "fsdp"), "tensor", None, None)
+    if dropout_rate > 0.0:
+        def body(q_, k_, v_, s_):
+            # decorrelate shards: the kernel hashes LOCAL (b, h) indices,
+            # so identical seeds would give every shard the same mask
+            shard = jnp.zeros((), jnp.int32)
+            for ax in ("replica", "fsdp", "tensor"):
+                shard = shard * jnp.int32(mesh.shape.get(ax, 1)) + (
+                    jax.lax.axis_index(ax)
+                    if mesh.shape.get(ax, 1) > 1
+                    else jnp.int32(0)
+                )
+            s_ = s_ + shard * jnp.int32(0x9E3779B1 & 0x7FFFFFFF)
+            return flash_attention_dropout(
+                q_, k_, v_, s_, dropout_rate, causal
+            )
+
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P()),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v, seed)
     return jax.shard_map(
         lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
         mesh=mesh,
@@ -160,16 +189,24 @@ def attention(
             deterministic=deterministic,
         )
     if impl == "flash":
-        from midgpt_tpu.ops.flash import flash_attention
-
-        assert dropout_rate == 0.0 or deterministic, (
-            "flash attention does not implement attention dropout — a "
-            "deliberate trade (PERF.md r2): the only dropout config in the "
-            "reference family is shakespeare_char (T=256, 10M params), "
-            "where naive attention's T^2 cost is negligible; every "
-            "OWT-family config runs dropout 0 on the flash path. "
-            "impl='auto' already routes dropout configs to naive."
+        from midgpt_tpu.ops.flash import (
+            flash_attention,
+            flash_attention_dropout,
         )
+
+        if dropout_rate > 0.0 and not deterministic:
+            assert dropout_key is not None, "attention dropout needs a key"
+            seed = jax.random.randint(
+                dropout_key, (), -(2**31), 2**31 - 1, dtype=jnp.int32
+            )
+            sharded = _flash_sharded(
+                q, k, v, causal, dropout_rate=dropout_rate, seed=seed
+            )
+            if sharded is not None:
+                return sharded
+            return flash_attention_dropout(
+                q, k, v, seed, dropout_rate, causal
+            )
         sharded = _flash_sharded(q, k, v, causal)
         if sharded is not None:
             return sharded

@@ -71,6 +71,40 @@ def _causal_mask_block(iq, ik, bq: int, bk: int) -> Array:
     return rows >= cols
 
 
+def _wrap32(c: int):
+    import numpy as np
+
+    return jnp.int32(np.uint32(c).astype(np.int32))
+
+
+def _hash_finalize(x: Array) -> Array:
+    """murmur3-style 32-bit finalizer (good avalanche) on int32 with
+    wrapping arithmetic — plain vector integer ops, so it runs identically
+    under Mosaic and the Pallas CPU interpreter (pltpu.prng_* has no
+    interpret-mode lowering, which would make dropout untestable here)."""
+    srl = jax.lax.shift_right_logical
+    x = (x ^ srl(x, 16)) * _wrap32(0x7FEB352D)
+    x = (x ^ srl(x, 15)) * _wrap32(0x846CA68B)
+    return x ^ srl(x, 16)
+
+
+def _dropout_keep_block(
+    seed, head_id, rows0, cols0, bq: int, bk: int, keep: float
+) -> Array:
+    """Deterministic Bernoulli(keep) over global score coordinates.
+
+    Element (row, col) of attention head ``head_id`` keeps its probability
+    iff hash(seed, head_id, row, col) falls under the keep threshold. The
+    same counters regenerate the identical mask in the backward kernels —
+    nothing is stored. [bq, bk] bool."""
+    rows = rows0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    x = rows * _wrap32(0x9E3779B1) + cols * _wrap32(0x85EBCA77)
+    x = x ^ (seed + head_id * _wrap32(0xC2B2AE35))
+    u24 = _hash_finalize(x) & jnp.int32(0x00FFFFFF)
+    return u24 < jnp.int32(int(keep * (1 << 24)))
+
+
 def _act_spec(rows: int, c: int, row_fn, head_fn):
     """BlockSpec for a q/k/v/o/do activation carrying ``rows`` sequence rows.
 
@@ -88,10 +122,18 @@ def _act_spec(rows: int, c: int, row_fn, head_fn):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+    *refs,
+    scale: float, causal: bool, bq: int, bk: int, nk: int,
+    keep: tp.Optional[float] = None, n_head: int = 0,
 ):
+    if keep is not None:
+        seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq, ik = pl.program_id(2), pl.program_id(3)
+    # program_id must bind OUTSIDE pl.when bodies (no interpret lowering
+    # inside the cond); the flat batch-head id seeds the dropout hash
+    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
 
     @pl.when(ik == 0)
     def _init():
@@ -124,9 +166,18 @@ def _fwd_kernel(
         m_next = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_next)  # [bq, 1]
         p = jnp.exp(z - m_next)  # [bq, bk] f32
+        # l (and thus lse) accumulates the UNDROPPED sum: dropout applies
+        # to softmax OUTPUTS (out = (softmax(z) * mask / keep) @ v), so
+        # only the value accumulation sees the mask
         l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        p_acc = p
+        if keep is not None:
+            mask = _dropout_keep_block(
+                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+            )
+            p_acc = jnp.where(mask, p * (1.0 / keep), 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_bcast = jax.lax.broadcast_in_dim(m_next, m_ref.shape, (0, 1))
@@ -144,7 +195,8 @@ def _fwd_kernel(
 
 
 def _flash_forward(
-    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int
+    q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int,
+    keep: tp.Optional[float] = None, seed: tp.Optional[Array] = None,
 ) -> tp.Tuple[Array, Array]:
     b, h, t, c = q.shape
     _, hkv, s, _ = k.shape
@@ -156,6 +208,7 @@ def _flash_forward(
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        keep=keep, n_head=h,
     )
     row_q = lambda b_, h_, iq, ik: iq  # noqa: E731
     # trimmed causal grid: masked (ik > iq) steps are compute-skipped
@@ -167,14 +220,19 @@ def _flash_forward(
         row_k = lambda b_, h_, iq, ik: ik  # noqa: E731
     kv_head = lambda h_: h_ // groups  # noqa: E731
     q_head = lambda h_: h_  # noqa: E731
+    in_specs = [
+        _act_spec(bq, c, row_q, q_head),
+        _act_spec(bk, c, row_k, kv_head),
+        _act_spec(bk, c, row_k, kv_head),
+    ]
+    operands = (q, k, v)
+    if keep is not None:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        operands = (seed.reshape(1).astype(jnp.int32),) + operands
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            _act_spec(bq, c, row_q, q_head),
-            _act_spec(bk, c, row_k, kv_head),
-            _act_spec(bk, c, row_k, kv_head),
-        ],
+        in_specs=in_specs,
         out_specs=[
             _act_spec(bq, c, row_q, q_head),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -191,7 +249,7 @@ def _flash_forward(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -201,10 +259,17 @@ def _flash_forward(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nk: int,
+    *refs,
+    scale: float, causal: bool, bq: int, bk: int, nk: int,
+    keep: tp.Optional[float] = None, n_head: int = 0,
 ):
+    if keep is not None:
+        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     iq, ik = pl.program_id(2), pl.program_id(3)
+    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
 
     @pl.when(ik == 0)
     def _init():
@@ -234,6 +299,14 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
+        if keep is not None:
+            # out = (p * mask/keep) @ v, so dz = p * (mask/keep * dp - delta)
+            # with the SAME regenerated mask (delta already absorbs out's
+            # dropped entries — it is rowsum(do * out))
+            mask = _dropout_keep_block(
+                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+            )
+            dp = jnp.where(mask, dp * (1.0 / keep), 0.0)
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -248,11 +321,18 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, bq: int, bk: int, nq: int,
+    *refs,
+    scale: float, causal: bool, bq: int, bk: int, nq: int,
+    keep: tp.Optional[float] = None, n_head: int = 0,
 ):
+    if keep is not None:
+        (seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
     ik, iq = pl.program_id(2), pl.program_id(3)
+    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
 
     @pl.when(iq == (ik if causal else 0))
     def _init():
@@ -280,14 +360,24 @@ def _bwd_dkv_kernel(
                 _NEG_INF,
             )
         p = jnp.exp(z - lse)  # [bq, bk]
-        # dv += p^T @ do  -> [bk, C]
-        dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        p_v = p
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
+        if keep is not None:
+            # NOTE transposed grid: this kernel's block rows start at
+            # iq * bq (grid is (b, h, ik, iq))
+            mask = _dropout_keep_block(
+                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+            )
+            inv = 1.0 / keep
+            p_v = jnp.where(mask, p * inv, 0.0)
+            dp = jnp.where(mask, dp * inv, 0.0)
+        # dv += (p * mask/keep)^T @ do  -> [bk, C]
+        dv_acc[:] += jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         ds = p * (dp - delta) * scale  # [bq, bk]
         # dk += ds^T @ q -> [bk, C]
         dk_acc[:] += jax.lax.dot_general(
@@ -303,7 +393,8 @@ def _bwd_dkv_kernel(
 
 def _flash_backward(
     q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
-    *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None
+    *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None,
+    keep: tp.Optional[float] = None, seed: tp.Optional[Array] = None,
 ) -> tp.Tuple[Array, Array, Array]:
     b, h, t, c = q.shape
     hkv = k.shape[1]
@@ -311,6 +402,11 @@ def _flash_backward(
     bq, bk = _block_sizes(t, bq, bk, causal)
     nq, nk = t // bq, t // bk
     scale = 1.0 / math.sqrt(c)
+    seed_ops: tp.Tuple[Array, ...] = ()
+    seed_specs: tp.List[tp.Any] = []
+    if keep is not None:
+        seed_ops = (seed.reshape(1).astype(jnp.int32),)
+        seed_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
 
     # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA; stored
     # [B, H, T, 1] (tiny, consumed by the kernels only).
@@ -338,9 +434,10 @@ def _flash_backward(
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            keep=keep, n_head=h,
         ),
         grid=(b, h, nq, nk),
-        in_specs=[
+        in_specs=seed_specs + [
             _act_spec(bq, c, row_q34, q_head),
             _act_spec(bk, c, row_k34, kv_head),
             _act_spec(bk, c, row_k34, kv_head),
@@ -354,15 +451,16 @@ def _flash_backward(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v, do, lse, delta)
+    )(*seed_ops, q, k, v, do, lse, delta)
 
     # dK/dV per Q-head (summed over GQA groups afterwards)
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            keep=keep, n_head=h,
         ),
         grid=(b, h, nk, nq),
-        in_specs=[
+        in_specs=seed_specs + [
             _act_spec(bq, c, row_q43, q_head),
             _act_spec(bk, c, row_k43, kv_head),
             _act_spec(bk, c, row_k43, kv_head),
@@ -391,7 +489,7 @@ def _flash_backward(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v, do, lse, delta)
+    )(*seed_ops, q, k, v, do, lse, delta)
 
     if groups > 1:
         dk = dk_h.reshape(b, hkv, groups, t, c).sum(axis=2).astype(k.dtype)
@@ -464,3 +562,76 @@ def flash_attention_reference(q, k, v, causal=True):
     from midgpt_tpu.ops.attention import naive_attention
 
     return naive_attention(q, k, v, causal=causal)
+
+
+# ---------------------------------------------------------------------------
+# Attention dropout (in-kernel mask regeneration, no stored mask)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_dropout(
+    q: Array,
+    k: Array,
+    v: Array,
+    seed: Array,  # [] or [1] int32 — per-call dropout seed
+    rate: float,
+    causal: bool = True,
+    block_q: tp.Optional[int] = None,
+    block_k: tp.Optional[int] = None,
+) -> Array:
+    """Flash attention with ATTENTION dropout: out = (softmax(z) * M/keep) @ v
+    with M ~ Bernoulli(keep) regenerated IN-KERNEL from (seed, b*H+h, row,
+    col) by a counter-based hash (_dropout_keep_block) — no O(T^2) mask in
+    HBM, and the backward kernels rebuild the identical mask from the same
+    counters. This removes the last math capability the kernels lacked
+    (VERDICT r3 Next #8): shakespeare_char — the only dropout config,
+    /root/reference/src/model.py:78 — no longer pins training to naive
+    O(T^2) attention.
+
+    The mask stream differs from naive_attention's jax.random.bernoulli
+    (different PRNG), so parity tests compare against an oracle built from
+    dropout_mask_reference — same hash, dense evaluation."""
+    out, _ = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k,
+        keep=1.0 - rate, seed=seed,
+    )
+    return out
+
+
+def _dropout_vjp_fwd(q, k, v, seed, rate, causal, block_q, block_k):
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k,
+        keep=1.0 - rate, seed=seed,
+    )
+    return out, (q, k, v, seed, out, lse)
+
+
+def _dropout_vjp_bwd(rate, causal, block_q, block_k, residuals, do):
+    q, k, v, seed, out, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do,
+        causal=causal, bq=block_q, bk=block_k,
+        keep=1.0 - rate, seed=seed,
+    )
+    return dq, dk, dv, None
+
+
+flash_attention_dropout.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
+
+
+def dropout_mask_reference(
+    seed: Array, b: int, h: int, t: int, rate: float
+) -> Array:
+    """[B, H, T, T] boolean keep-mask — the DENSE evaluation of the exact
+    hash the kernels regenerate blockwise. Test oracle only (O(T^2))."""
+    keep = 1.0 - rate
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    x = rows * _wrap32(0x9E3779B1) + cols * _wrap32(0x85EBCA77)
+    head_ids = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+    x = x[None, None] ^ (
+        jnp.asarray(seed, jnp.int32).reshape(()) + head_ids * _wrap32(0xC2B2AE35)
+    )
+    u24 = _hash_finalize(x) & jnp.int32(0x00FFFFFF)
+    return u24 < jnp.int32(int(keep * (1 << 24)))

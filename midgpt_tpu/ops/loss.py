@@ -27,7 +27,6 @@ cannot clone; the reshape form never creates manual collectives.)
 
 from __future__ import annotations
 
-import math
 import typing as tp
 
 import jax
@@ -65,8 +64,19 @@ def chunked_softmax_xent(
     t_local = t // sp
     if sp > 1:
         # per-shard chunk: keep the configured size when it divides the
-        # local T, else the largest common divisor (>=1 always divides)
-        ct = chunk_t if t_local % chunk_t == 0 else math.gcd(t_local, chunk_t)
+        # local T, else the LARGEST divisor of T/S below it (gcd could
+        # silently collapse to near-1-token chunks and serialize the scan)
+        ct = min(chunk_t, t_local)
+        while t_local % ct:
+            ct -= 1
+        if ct != chunk_t:
+            import warnings
+
+            warnings.warn(
+                f"loss_chunk={chunk_t} does not divide the per-shard "
+                f"sequence T/S={t_local}; using chunk {ct}",
+                stacklevel=2,
+            )
     else:
         assert t % chunk_t == 0, f"T={t} not divisible by chunk_t={chunk_t}"
         ct = chunk_t

@@ -45,9 +45,11 @@ def _to_varying(x: Array, axis: str) -> Array:
         return jax.lax.pcast(x, axis, to="varying")
     return jax.lax.pvary(x, axis)
 
-StageFn = tp.Callable[[tp.Any, Array], Array]
-"""(stage_params, activation [Bm, ...]) -> activation [Bm, ...]; applies
-one stage's worth of layers (e.g. a lax.scan over the local layer chunk)."""
+StageFn = tp.Callable[..., Array]
+"""(stage_params, activation [Bm, ...][, keys [L/S, 2]]) -> activation
+[Bm, ...]; applies one stage's worth of layers (e.g. a lax.scan over the
+local layer chunk). The keys argument is passed iff ``keys`` was given to
+pipeline_forward (per-layer dropout keys for the current microbatch)."""
 
 
 def pipeline_forward(
@@ -56,6 +58,7 @@ def pipeline_forward(
     stage_fn: StageFn,
     mesh: Mesh,
     *,
+    keys: tp.Optional[Array] = None,  # [L, M, 2] uint32 per-layer/microbatch
     axis: str = "pipeline",
     remat: bool = True,
     check_vma: bool = True,
@@ -63,6 +66,11 @@ def pipeline_forward(
     """Run ``x`` through all L layers, pipelined over the ``axis`` stages.
 
     Returns [M, Bm, ...] outputs (same sharding layout as ``x``).
+
+    ``keys`` threads dropout through the tick schedule: raw uint32
+    [L, M, 2] key data, split over stages on the layer axis exactly like
+    the params; at tick t, stage s slices the keys of the microbatch it is
+    processing (m = t - s) and hands its [L/S, 2] slab to stage_fn.
     """
     n_stages = mesh.shape[axis]
     m = x.shape[0]
@@ -77,7 +85,7 @@ def pipeline_forward(
     if remat:
         body = jax.checkpoint(stage_fn)
 
-    def per_stage(params_local, x_local):
+    def per_stage(params_local, x_local, keys_local):
         # params_local leaves: [L/S, ...] (shard_map strips the stage dim)
         # x_local: [M, Bm, ...] (replicated across the pipeline axis).
         # Everything entering the tick carry is promoted to pipeline-VARYING
@@ -99,7 +107,16 @@ def pipeline_forward(
             in_act = jnp.where(s_idx == 0, mb, recv)
             # active window for this stage: t in [s_idx, s_idx + M)
             active = jnp.logical_and(t >= s_idx, t < s_idx + m)
-            out_act = body(params_local, in_act)
+            if keys_local is None:
+                out_act = body(params_local, in_act)
+            else:
+                # this stage is working on microbatch t - s_idx (clamped
+                # on inactive ticks, whose output is masked anyway)
+                k_mb = jax.lax.dynamic_index_in_dim(
+                    keys_local, jnp.clip(t - s_idx, 0, m - 1),
+                    axis=1, keepdims=False,
+                )  # [L/S, 2]
+                out_act = body(params_local, in_act, k_mb)
             out_act = jnp.where(active, out_act, zero_act)
             # bank the last stage's finished microbatch (m_done = t - (S-1));
             # non-banking ticks write back the existing slot unchanged
@@ -138,6 +155,7 @@ def pipeline_forward(
     in_specs = (
         jax.tree.map(lambda _: P(axis), stacked_params),  # stage dim = leading
         P(),  # input replicated over the pipeline axis
+        P(axis) if keys is not None else P(),  # keys split like the params
     )
     # partial-auto: only the pipeline axis is manual; any other mesh axes
     # (replica/fsdp/sequence/tensor) stay under GSPMD, so PP composes with
@@ -149,7 +167,7 @@ def pipeline_forward(
         out_specs=P(),
         axis_names={axis},
         check_vma=check_vma,
-    )(stacked_params, x)
+    )(stacked_params, x, keys)
 
 
 def stage_scan_fn(block_fn: tp.Callable[[tp.Any, Array], Array]) -> StageFn:
@@ -174,6 +192,9 @@ def gpt_pipeline_hidden(
     *,
     n_micro: int = 0,
     axis: str = "pipeline",
+    key: tp.Optional[Array] = None,
+    deterministic: bool = True,
+    boundary_dtype: tp.Optional[str] = None,
 ) -> Array:
     """GPT forward with the block stack pipelined over ``axis``.
 
@@ -186,11 +207,12 @@ def gpt_pipeline_hidden(
     the shard_map, which is manual ONLY over the pipeline axis — data /
     tensor sharding of the activations stays with GSPMD (partial-auto).
 
-    Deterministic-only: GPipe microbatch scheduling does not thread
-    per-layer dropout keys (all OWT-family configs run dropout 0).
-    Returns ln_f-normalized hidden states [B, T, D]."""
+    Dropout threads through the tick schedule: per-(layer, microbatch)
+    keys ride the stage shard_map next to the params (pipeline_forward's
+    ``keys``), so dropout configs train under PP too (r3 left this
+    deterministic-only). Returns ln_f-normalized hidden [B, T, D]."""
     from midgpt_tpu.models.gpt import embed_tokens
-    from midgpt_tpu.models.layers import rope_tables
+    from midgpt_tpu.models.layers import dropout as dropout_fn, rope_tables
     from midgpt_tpu.parallel.sharding import axis_rules, shard_act
 
     cfg = model.config
@@ -222,32 +244,59 @@ def gpt_pipeline_hidden(
             )
     sin, cos = rope_tables(cfg.head_dim, t, cfg.rope_base)
     impl = cfg.attn_impl
+    has_dropout = cfg.dropout > 0.0 and not deterministic and key is not None
 
+    drop_key, block_key = (
+        jax.random.split(key) if has_dropout else (None, None)
+    )
     h = embed_tokens(model.wte, tokens)  # [B, T, D]
+    h = dropout_fn(h, cfg.dropout, drop_key, not has_dropout)
     h = shard_act(h, "batch", "seq", "embed")
     compute_dtype = h.dtype
-    # activations cross the shard_map boundary (and ride the inter-stage
-    # ppermutes) in float32: a bf16 shard_map output consumed as a backward
-    # residual miscompiles XLA ("Invalid binary instruction opcode copy",
-    # reduced repro in tests/test_pipeline.py history). Stage-internal
-    # compute stays in the model's compute dtype.
-    boundary_dtype = (
-        jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
-    )
-    h = h.astype(boundary_dtype).reshape(m, b // m, t, cfg.n_embd)
+    # activations cross the shard_map boundary (and the inter-stage
+    # ppermutes) in float32 by default: a bf16 manual-boundary all-reduce
+    # crashes XLA CPU's AllReducePromotion pass on the current pin
+    # ("Invalid binary instruction opcode copy" — re-confirmed r4; the
+    # same bug bit the chunked-loss shard_map, ops/loss.py). The pass is
+    # CPU-backend-side, so MeshConfig.pp_boundary_dtype="bfloat16" is
+    # worth trying on real TPU hardware (halves ppermute bytes).
+    if boundary_dtype is not None:
+        bdtype = jnp.dtype(boundary_dtype)
+    else:
+        bdtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    h = h.astype(bdtype).reshape(m, b // m, t, cfg.n_embd)
 
-    def stage_fn(params_local, x):
+    keys = None
+    if has_dropout:
+        n_layer = cfg.n_layer
+        keys = jax.random.split(block_key, n_layer * m).reshape(n_layer, m, 2)
+
+    def stage_fn(params_local, x, *stage_keys):
         # one cast per stage boundary, not per layer; no activation-sharding
         # constraints inside the manual region (the pipeline axis is
         # invisible to GSPMD there; auto axes keep the inputs' shardings)
         with axis_rules(None):
-            def body(hh, bp):
-                return bp(hh, sin, cos, impl=impl, deterministic=True), None
+            if stage_keys:
+                def body(hh, layer):
+                    bp, k_l = layer
+                    return bp(
+                        hh, sin, cos, impl=impl, key=k_l, deterministic=False
+                    ), None
 
-            y, _ = jax.lax.scan(body, x.astype(compute_dtype), params_local)
-        return y.astype(boundary_dtype)
+                y, _ = jax.lax.scan(
+                    body, x.astype(compute_dtype),
+                    (params_local, stage_keys[0]),
+                )
+            else:
+                def body(hh, bp):
+                    return bp(hh, sin, cos, impl=impl, deterministic=True), None
 
-    out = pipeline_forward(model.blocks, h, stage_fn, mesh, axis=axis)
+                y, _ = jax.lax.scan(body, x.astype(compute_dtype), params_local)
+        return y.astype(bdtype)
+
+    out = pipeline_forward(
+        model.blocks, h, stage_fn, mesh, keys=keys, axis=axis
+    )
     h = out.reshape(b, t, cfg.n_embd).astype(compute_dtype)
     h = shard_act(h, "batch", "seq", "embed")
     return model.ln_f(h)

@@ -96,6 +96,7 @@ def loss_fn(
     loss_chunk_unroll: tp.Union[bool, int] = False,
     pp_mesh=None,
     pp_microbatches: int = 0,
+    pp_boundary_dtype: tp.Optional[str] = None,
 ) -> Array:
     """Batched xent; logits in f32 (parity: train.py:72-77). With
     ``loss_chunk``, the head projection + xent run T-chunk by T-chunk
@@ -105,11 +106,10 @@ def loss_fn(
     if pp_mesh is not None:
         from midgpt_tpu.parallel.pipeline import gpt_pipeline_hidden
 
-        assert key is None and deterministic, (
-            "the pipeline-parallel path is deterministic-only (GPipe "
-            "scheduling does not thread per-layer dropout keys)"
+        h = gpt_pipeline_hidden(
+            model, x, pp_mesh, n_micro=pp_microbatches, key=key,
+            deterministic=deterministic, boundary_dtype=pp_boundary_dtype,
         )
-        h = gpt_pipeline_hidden(model, x, pp_mesh, n_micro=pp_microbatches)
     else:
         h = model.hidden(x, key=key, deterministic=deterministic)
     if loss_chunk is not None:
@@ -160,10 +160,6 @@ def make_train_step(
     if param_rules is None:
         param_rules = _cfg_param_rules(cfg)
     pp_mesh = mesh if cfg.mesh.pipeline > 1 else None
-    if pp_mesh is not None:
-        assert not has_dropout, (
-            "pipeline parallelism is deterministic-only; set dropout=0"
-        )
 
     def step_fn(state: TrainState, x: Array, y: Array, key: Array):
         # x, y: [G, B, T]
@@ -182,6 +178,7 @@ def make_train_step(
                 cfg.loss_chunk_unroll,
                 pp_mesh,
                 cfg.mesh.pp_microbatches,
+                cfg.mesh.pp_boundary_dtype,
             )
             # keep accumulated grads sharded like params (train.py:87)
             grads = constrain_params(grads, mesh, param_rules)
@@ -199,6 +196,7 @@ def make_train_step(
                 cfg.loss_chunk_unroll,
                 pp_mesh,
                 cfg.mesh.pp_microbatches,
+                cfg.mesh.pp_boundary_dtype,
             )
             grads = constrain_params(grads, mesh, param_rules)
         else:
@@ -238,6 +236,7 @@ def make_eval_step(cfg: ExperimentConfig, mesh):
             return loss_fn(
                 params_c, x, y, None, True, loss_chunk,
                 cfg.loss_chunk_unroll, pp_mesh, cfg.mesh.pp_microbatches,
+                cfg.mesh.pp_boundary_dtype,
             )
 
     return jax.jit(eval_fn)
@@ -328,19 +327,31 @@ def resolve_auto_knobs(cfg: ExperimentConfig, n_devices: int,
     n_params = m.n_layer * per_layer_params + 2 * m.vocab_size * m.n_embd
     state_bytes = n_params * 12  # f32 params + Adam m,v (donated step)
 
-    tokens_per_dev = cfg.microbatch_size * m.block_size / max(1, n_devices)
-    per_token_act = m.n_layer * (4 * m.n_embd + f + m.n_head * c + hidden) * 2
+    # tokens are sharded over the DATA axes only (batch over replica*fsdp,
+    # T over sequence); TP shards the hidden/head dims of each token's
+    # activations instead (ADVICE r3: dividing by ALL devices undercounted
+    # per-device activations by tensor_sz on TP meshes)
+    try:
+        pp_sz, rep_sz, fsdp_sz, seq_sz, tensor_sz = cfg.mesh.sizes(n_devices)
+    except AssertionError:
+        pp_sz, rep_sz, fsdp_sz, seq_sz, tensor_sz = (
+            1, 1, max(1, n_devices), 1, 1,
+        )
+    data_shards = max(1, rep_sz * fsdp_sz * seq_sz)
+    tokens_per_dev = cfg.microbatch_size * m.block_size / data_shards
+    # each pipeline stage holds (and saves activations for) n_layer/pp
+    per_token_act = (
+        m.n_layer / max(1, pp_sz)
+        * (4 * m.n_embd + (f + m.n_head * c + hidden) / max(1, tensor_sz))
+        * 2
+    )
     act_none = tokens_per_dev * per_token_act
 
     remat = m.remat
     if remat == "auto":
         # params/optimizer state shard over the fsdp AND tensor axes
-        # (GPT_PARAM_RULES); resolve -1 via MeshConfig.sizes
-        try:
-            _, _, fsdp_sz, _, tensor_sz = cfg.mesh.sizes(n_devices)
-            state_shards = max(1, fsdp_sz * tensor_sz)
-        except AssertionError:
-            state_shards = max(1, n_devices)
+        # (GPT_PARAM_RULES)
+        state_shards = max(1, fsdp_sz * tensor_sz)
         fill = (state_bytes / state_shards + act_none) / hbm_bytes
         # calibration on a 16G v5e (PERF.md r3): fill 0.77 (llama-L2 B=8)
         # runs at remat=none; fill 0.80 (124M B=48) fails to compile
@@ -392,6 +403,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     except ValueError:  # non-main thread (tests driving train() directly)
         prev_handler = None
     try:
+        _remat_was_auto = cfg.model.remat == "auto"
         cfg = resolve_auto_knobs(cfg, jax.device_count())
         mesh = create_mesh(cfg.mesh)
         n_proc = jax.process_count()
@@ -432,6 +444,52 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         tx, schedule = make_optimizer(cfg)
         train_step = make_train_step(cfg, tx, mesh)
         eval_step = make_eval_step(cfg, mesh)
+
+        # resolve_auto_knobs' HBM-fit estimate is calibrated on one chip
+        # class (PERF.md); when it over-reaches on an unmeasured chip, the
+        # FIRST step OOMs — step down the remat ladder instead of crashing
+        # (ADVICE r3). The first step is synced inside the guard so the
+        # failure surfaces here (not at a later async host read); retry is
+        # only attempted while the donated state buffers are still alive
+        # (compile-time OOM raises before donation consumes them — a
+        # runtime OOM that already ate the state re-raises with the
+        # original error).
+        _first_step_done = {"done": not _remat_was_auto}
+
+        def exec_step(state, xg, yg, k):
+            nonlocal train_step, cfg
+            if _first_step_done["done"]:
+                return train_step(state, xg, yg, k)
+            while True:
+                try:
+                    out = train_step(state, xg, yg, k)
+                    jax.block_until_ready(out)
+                    _first_step_done["done"] = True
+                    return out
+                except Exception as e:  # noqa: BLE001 — filtered below
+                    nxt = {"none": "dots", "dots": "full"}.get(cfg.model.remat)
+                    state_alive = not any(
+                        getattr(a, "is_deleted", lambda: False)()
+                        for a in jax.tree.leaves(state.params)
+                    )
+                    if (
+                        "RESOURCE_EXHAUSTED" not in str(e)
+                        or nxt is None
+                        or not state_alive
+                    ):
+                        raise
+                    if proc == 0:
+                        print(
+                            f"first-step OOM at remat={cfg.model.remat}; "
+                            f"retrying with remat={nxt}"
+                        )
+                    cfg = dataclasses.replace(
+                        cfg,
+                        model=dataclasses.replace(
+                            cfg.model, remat=nxt, scan_unroll=1
+                        ),
+                    )
+                    train_step = make_train_step(cfg, tx, mesh)
 
         ckpt = Checkpointer(
             cfg.rundir,
@@ -531,10 +589,11 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             # pre-training / post-restore point (parity: train.py:195-201)
             if itr % cfg.eval_interval == 0 or itr == first_step:
                 n_eval = 1 if cfg.debug else cfg.eval_batches
+                eoff = 0 if cfg.eval_fixed else itr
                 train_loss = evaluate(
-                    eval_step, state.params, train_eval_loader, mesh, n_eval, itr
+                    eval_step, state.params, train_eval_loader, mesh, n_eval, eoff
                 )
-                val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, itr)
+                val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, eoff)
                 logger.log(itr, {"loss/train": train_loss, "loss/val": val_loss})
                 final.update({"train_loss": train_loss, "val_loss": val_loss})
 
@@ -544,10 +603,10 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             if cfg.debug and itr == first_step + 1 and not cfg.rundir.startswith("gs://"):
                 # profile exactly one post-warmup step (parity: train.py:205-211)
                 with jax.profiler.trace(os.path.join(cfg.rundir, "profile")):
-                    state, loss = train_step(state, xg, yg, step_key)
+                    state, loss = exec_step(state, xg, yg, step_key)
                     jax.block_until_ready(loss)
             else:
-                state, loss = train_step(state, xg, yg, step_key)
+                state, loss = exec_step(state, xg, yg, step_key)
 
             if itr % cfg.log_interval == 0 and itr > 0:
                 loss_v = float(loss)
@@ -605,7 +664,8 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # the in-loop convention is "meta step == completed itr")
         n_eval = 1 if cfg.debug else cfg.eval_batches
         final["val_loss"] = evaluate(
-            eval_step, state.params, val_loader, mesh, n_eval, cfg.max_steps
+            eval_step, state.params, val_loader, mesh, n_eval,
+            0 if cfg.eval_fixed else cfg.max_steps,
         )
         logger.log(cfg.max_steps, {"loss/val": final["val_loss"]})
         if (

@@ -1,0 +1,232 @@
+"""Side-by-side loss parity vs the ACTUAL reference implementation.
+
+VERDICT r3 Missing #1: "matches reference loss" was an inference, never a
+measurement. This script runs BOTH frameworks on the identical synthetic
+shakespeare-style token file, same hyperparameters, same step count, on
+the 8-device CPU mesh, and asserts final-val agreement:
+
+- reference: /root/reference's own ``src.train.train()`` loop, unmodified,
+  via the minimal equinox shim (scripts/eqx_shim.py) and a wandb stub that
+  records its logged loss series (the image has no equinox/wandb and zero
+  egress). Reference: /root/reference/src/train.py:127-225.
+- ours: midgpt_tpu.train.train() with the matching ModelConfig (init-only
+  tied embeddings, QK-LN, GELU MLP, naive attention — the reference math).
+
+Data order and init keys necessarily differ between frameworks (different
+loader/RNG designs), so the assertion is on the CONVERGED final val loss,
+not per-step curves. Writes artifacts/reference_parity.json with both
+series.
+
+    python scripts/check_reference_parity.py [--steps 600] [--tol 0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import types
+
+# respect an explicitly-set XLA_FLAGS (the parent sets 8 virtual devices
+# for the reference child and single-device for ours); default to 8
+if os.environ.get("XLA_FLAGS") is None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+# shrunken-but-faithful shakespeare_char family shape (the full 6x384
+# config runs hours on CPU; both sides get the identical shrink)
+MODEL = dict(block_size=256, vocab_size=65, n_layer=4, n_head=6, n_embd=192)
+HPARAMS = dict(
+    learning_rate=1e-3, min_lr=1e-4, beta2=0.99, weight_decay=1e-4,
+    batch_size=32, g_accum_iters=1,
+)
+
+
+def _prepare_data(outdir: str) -> str:
+    """Identical synthetic token file for both frameworks."""
+    sys.path.insert(0, os.path.join(REPO, "data", "shakespeare_char"))
+    import prepare as prep  # noqa
+
+    datadir = os.path.join(outdir, "data")
+    os.makedirs(datadir, exist_ok=True)
+    argv, sys.argv = sys.argv, ["prepare.py", "--synthetic", "--out_dir", datadir]
+    try:
+        prep.main()
+    finally:
+        sys.argv = argv
+    return datadir
+
+
+def run_reference(datadir: str, steps: int, eval_interval: int,
+                  debug: bool = False) -> dict:
+    """Run /root/reference's train() via the equinox shim; returns the
+    loss series its loop logs to (stubbed) wandb."""
+    from eqx_shim import make_equinox_module
+
+    logged: dict = {"train": [], "val": [], "opt": []}
+    wandb = types.ModuleType("wandb")
+
+    def _log(d, step=None):
+        if "loss/train" in d:
+            logged["train"].append((step, float(d["loss/train"])))
+            logged["val"].append((step, float(d["loss/val"])))
+        if "loss/optimized" in d:
+            logged["opt"].append((step, float(d["loss/optimized"])))
+
+    wandb.log = _log
+    wandb.finish = lambda *a, **k: None
+    wandb.init = lambda *a, **k: None
+
+    sys.modules["equinox"] = make_equinox_module()
+    sys.modules["wandb"] = wandb
+    if not hasattr(jax, "tree_map"):  # removed in newer jax; reference uses it
+        jax.tree_map = jax.tree.map
+    sys.path.insert(0, REFERENCE)
+    from src.model import GPTConfig
+    from src.train import ExperimentConfig, train
+
+    rundir = tempfile.mkdtemp(prefix="ref_parity_")
+    cfg = ExperimentConfig(
+        rundir=rundir,
+        data_dir=datadir,
+        warmup_steps=max(1, steps // 10),
+        lr_decay_steps=steps,
+        max_steps=steps,
+        eval_interval=eval_interval,
+        param_dtype="float32",
+        compute_dtype="bfloat16",
+        shard_model=False,
+        model_config=GPTConfig(dropout=0.0, **MODEL),
+        debug=debug,  # smoke mode: 1-batch evals, no checkpointing
+        **HPARAMS,
+    )
+    np.random.seed(0)  # the reference's get_batch uses global numpy RNG
+    train(cfg)
+    return logged
+
+
+def run_ours(datadir: str, steps: int, eval_interval: int,
+             debug: bool = False) -> dict:
+    from midgpt_tpu.config import (
+        ExperimentConfig, MeshConfig, ModelConfig,
+    )
+    from midgpt_tpu.train import train
+
+    rundir = tempfile.mkdtemp(prefix="ours_parity_")
+    cfg = ExperimentConfig(
+        model=ModelConfig(
+            dropout=0.0, attn_impl="naive", remat="full", scan_unroll=1,
+            qk_norm=True, tie_embeddings=False, mlp="gelu", **MODEL,
+        ),
+        data_dir=datadir,
+        rundir=rundir,
+        warmup_steps=max(1, steps // 10),
+        lr_decay_steps=steps,
+        max_steps=steps,
+        eval_interval=eval_interval,
+        eval_batches=1 if debug else 200,  # the reference's evaluate() uses 200
+        # fsdp=-1 -> all visible devices (the parent runs this side
+        # single-device: same math, no CPU collective rendezvous)
+        mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
+        **HPARAMS,
+    )
+    final = train(cfg)
+    series = []
+    with open(os.path.join(rundir, "metrics.jsonl")) as f:
+        for line in f:
+            series.append(json.loads(line))
+    return {"final": final, "series": series}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--eval_interval", type=int, default=200)
+    ap.add_argument("--tol", type=float, default=0.1,
+                    help="max |final val loss difference| in nats")
+    ap.add_argument("--side", choices=("ref", "ours", "both"), default="both")
+    ap.add_argument("--debug", action="store_true",
+                    help="smoke mode: 1-batch evals, no reference ckpts")
+    ap.add_argument("--datadir", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.side != "both":
+        # child mode: run one side, dump its series as JSON
+        result = (
+            run_reference if args.side == "ref" else run_ours
+        )(args.datadir, args.steps, args.eval_interval, debug=args.debug)
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+        return
+
+    # parent: one subprocess per side. This box exposes ONE physical core;
+    # the reference needs its 8-virtual-device mesh (its train() hardcodes
+    # an (n//8, 8) mesh), but running both sides plus 8-thread CPU
+    # collective rendezvous in one contended process deadlocks XLA's
+    # 40s rendezvous timeout. Ours runs single-device (identical math).
+    import subprocess
+
+    outdir = os.path.join(REPO, "artifacts")
+    os.makedirs(outdir, exist_ok=True)
+    datadir = _prepare_data(tempfile.mkdtemp(prefix="parity_data_"))
+
+    results = {}
+    for side, flags in (("ref", "--xla_force_host_platform_device_count=8"),
+                        ("ours", "")):
+        out = tempfile.mktemp(suffix=f"_{side}.json")
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = flags
+        env["PALLAS_AXON_POOL_IPS"] = ""  # keep jax off the TPU relay
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--side", side, "--datadir", datadir, "--out", out,
+               "--steps", str(args.steps),
+               "--eval_interval", str(args.eval_interval)]
+        if args.debug:
+            cmd.append("--debug")
+        print(f"[parity] running {side} ...", flush=True)
+        subprocess.run(cmd, check=True, env=env)
+        with open(out) as f:
+            results[side] = json.load(f)
+
+    ref, ours = results["ref"], results["ours"]
+    ref_val = ref["val"][-1][1]
+    our_val = float(ours["final"]["val_loss"])
+    record = {
+        "model": MODEL,
+        "hparams": HPARAMS,
+        "steps": args.steps,
+        "reference": ref,
+        "ours_final": ours["final"],
+        "ours_series": ours["series"],
+        "ref_final_val": ref_val,
+        "our_final_val": our_val,
+        "abs_diff": abs(ref_val - our_val),
+        "tol": args.tol,
+    }
+    with open(os.path.join(outdir, "reference_parity.json"), "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({k: record[k] for k in
+                      ("ref_final_val", "our_final_val", "abs_diff", "tol")}))
+    assert abs(ref_val - our_val) <= args.tol, (
+        f"final val loss diverged: reference {ref_val:.4f} vs ours "
+        f"{our_val:.4f} (tol {args.tol})"
+    )
+    print("PARITY OK")
+
+
+if __name__ == "__main__":
+    main()

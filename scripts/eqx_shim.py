@@ -1,0 +1,259 @@
+"""Minimal equinox-compatible shim so the REFERENCE implementation
+(/root/reference — pure JAX + Equinox) can run in this image, where
+equinox is not installed and cannot be (zero egress).
+
+Used ONLY by scripts/check_reference_parity.py to produce the
+side-by-side loss-parity measurement (VERDICT r3 Missing #1). Implements
+exactly the API surface the reference uses (grep over /root/reference:
+Module, field, is_array, partition/combine/filter, filter_jit,
+filter_vmap, Partial, tree_pprint, nn.Dropout, nn.LayerNorm) with
+equinox's semantics for those calls — nothing more.
+
+Install with:  sys.modules["equinox"] = make_equinox_module()
+BEFORE importing the reference package.
+"""
+
+from __future__ import annotations
+
+import functools
+import types
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _FieldSpec:
+    def __init__(self, static: bool = False):
+        self.static = static
+
+
+def field(*, static: bool = False, **_kw):
+    return _FieldSpec(static=static)
+
+
+def is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def _collect_fields(cls) -> tp.Tuple[tp.Tuple[str, ...], tp.Tuple[str, ...]]:
+    """(dynamic_field_names, static_field_names) in annotation order
+    across the MRO (base classes first), deduplicated."""
+    dyn, static = [], []
+    seen = set()
+    for klass in reversed(cls.__mro__):
+        for name in getattr(klass, "__annotations__", {}):
+            if name in seen or name.startswith("__"):
+                continue
+            seen.add(name)
+            spec = klass.__dict__.get(name)
+            if isinstance(spec, _FieldSpec) and spec.static:
+                static.append(name)
+            else:
+                dyn.append(name)
+    return tuple(dyn), tuple(static)
+
+
+class Module:
+    """Equinox-style module: annotated fields form a pytree; fields
+    declared with ``field(static=True)`` ride in the treedef aux data."""
+
+    _dyn_fields: tp.ClassVar[tp.Tuple[str, ...]] = ()
+    _static_fields: tp.ClassVar[tp.Tuple[str, ...]] = ()
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls._dyn_fields, cls._static_fields = _collect_fields(cls)
+
+        def flatten(obj):
+            children = tuple(getattr(obj, f) for f in cls._dyn_fields)
+            aux = tuple(getattr(obj, f) for f in cls._static_fields)
+            return children, aux
+
+        def unflatten(aux, children):
+            obj = object.__new__(cls)
+            for f, v in zip(cls._dyn_fields, children):
+                object.__setattr__(obj, f, v)
+            for f, v in zip(cls._static_fields, aux):
+                object.__setattr__(obj, f, v)
+            return obj
+
+        jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+
+
+class Partial(Module):
+    """Pytree-aware functools.partial (the reference wraps a model with
+    ``inference=True`` for evaluation)."""
+
+    func: tp.Any
+    args: tp.Tuple
+    keywords: tp.Dict[str, tp.Any]
+
+    def __init__(self, func, *args, **kwargs):
+        self.func = func
+        self.args = args
+        self.keywords = kwargs
+
+    def __call__(self, *args, **kwargs):
+        return self.func(*self.args, *args, **{**self.keywords, **kwargs})
+
+
+_MISSING = object()
+
+
+def _is_none(x) -> bool:
+    return x is None
+
+
+def partition(tree, filter_fn):
+    """(matching, rest) — non-matching leaves replaced by None and vice
+    versa, same treedef. Mirrors eqx.partition for leaf-level filters."""
+    dynamic = jax.tree_util.tree_map(
+        lambda x: x if filter_fn(x) else None, tree
+    )
+    static = jax.tree_util.tree_map(
+        lambda x: None if filter_fn(x) else x, tree
+    )
+    return dynamic, static
+
+
+def combine(*trees):
+    def pick(*vals):
+        for v in vals:
+            if v is not None:
+                return v
+        return None
+
+    return jax.tree_util.tree_map(pick, *trees, is_leaf=_is_none)
+
+
+def filter(tree, filter_fn):  # noqa: A001 — equinox's name
+    return partition(tree, filter_fn)[0]
+
+
+def _static_key(static) -> tp.Hashable:
+    leaves, treedef = jax.tree_util.tree_flatten(static)
+    return (treedef, tuple(leaves))
+
+
+def filter_jit(fn=None, *, donate: str = "none"):
+    """jit that traces array leaves and treats everything else as static
+    (cached per static-structure so jit's own compile cache applies)."""
+    if fn is None:
+        return functools.partial(filter_jit, donate=donate)
+    cache: tp.Dict[tp.Hashable, tp.Any] = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        dynamic, static = partition(args, is_array)
+        key = _static_key(static)
+        if key not in cache:
+            out_static = {}
+
+            def run(dyn, _static=static):
+                merged = combine(dyn, _static)
+                out = fn(*merged)
+                # non-array outputs ride outside the jit, like equinox
+                out_dyn, out_static["v"] = partition(out, is_array)
+                return out_dyn
+
+            cache[key] = (
+                jax.jit(run, donate_argnums=(0,) if donate == "all" else ()),
+                out_static,
+            )
+        jitted, out_static = cache[key]
+        out_dyn = jitted(dynamic)
+        return combine(out_dyn, out_static["v"])
+
+    return wrapper
+
+
+def filter_vmap(fn):
+    """vmap where array outputs are batched and non-array outputs are
+    captured unbatched (enough for the reference's stacked-Block init)."""
+
+    def wrapper(*args):
+        captured = {}
+
+        def inner(*a):
+            out = fn(*a)
+            dyn, static = partition(out, is_array)
+            captured["static"] = static
+            return dyn
+
+        dyn = jax.vmap(inner)(*args)
+        return combine(dyn, captured["static"])
+
+    return wrapper
+
+
+def tree_pprint(tree, **kw):  # pragma: no cover — cosmetic
+    print(jax.tree_util.tree_structure(tree))
+
+
+class _Dropout(Module):
+    p: float
+    inference: bool
+
+    def __init__(self, p: float = 0.5, inference: bool = False):
+        self.p = p
+        self.inference = inference
+
+    def __call__(self, x, *, key=None, inference=None):
+        inference = self.inference if inference is None else inference
+        if inference or self.p == 0.0:
+            return x
+        if key is None:
+            raise RuntimeError("Dropout requires a key when not inference")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class _LayerNorm(Module):
+    shape: tp.Any
+    eps: float
+    use_weight: bool
+    use_bias: bool
+    weight: tp.Optional[jax.Array]
+    bias: tp.Optional[jax.Array]
+
+    def __init__(self, shape, eps: float = 1e-5, use_weight: bool = True,
+                 use_bias: bool = True, **_kw):
+        self.shape = shape
+        self.eps = eps
+        self.use_weight = use_weight
+        self.use_bias = use_bias
+        self.weight = jnp.ones(shape) if use_weight else None
+        self.bias = jnp.zeros(shape) if use_bias else None
+
+    def __call__(self, x, *, key=None):
+        mean = jnp.mean(x, keepdims=True)
+        variance = jnp.var(x, keepdims=True)
+        inv = jax.lax.rsqrt(variance + self.eps)
+        out = (x - mean) * inv
+        if self.weight is not None:
+            out = out * self.weight
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def make_equinox_module() -> types.ModuleType:
+    eqx = types.ModuleType("equinox")
+    eqx.Module = Module
+    eqx.field = field
+    eqx.is_array = is_array
+    eqx.partition = partition
+    eqx.combine = combine
+    eqx.filter = filter
+    eqx.filter_jit = filter_jit
+    eqx.filter_vmap = filter_vmap
+    eqx.Partial = Partial
+    eqx.tree_pprint = tree_pprint
+    nn = types.ModuleType("equinox.nn")
+    nn.Dropout = _Dropout
+    nn.LayerNorm = _LayerNorm
+    eqx.nn = nn
+    return eqx

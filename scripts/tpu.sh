@@ -85,16 +85,27 @@ case "$cmd" in
         grep -qi "already attached" /tmp/attach_err \
           || { cat /tmp/attach_err >&2; exit 1; }
       fi
-      # find the device by disk name (not /dev/sdb — enumeration order is
-      # not stable); read-only-attached ext4 needs '-o ro,noload'. Failure
-      # must surface: a silent no-data mount strands the openwebtext runs.
+      # find the device by disk name ONLY — never guess /dev/sdb
+      # (enumeration order is unstable; a wrong-disk mount passes a bare
+      # readability check and silently strands the openwebtext runs —
+      # ADVICE r3). Verify the mount actually holds the dataset dir.
+      marker="${TPU_DATA_MARKER:-openwebtext}"
       all_hosts "set -e; \
         dev=\$(readlink -f /dev/disk/by-id/google-${TPU_DATA_DISK} 2>/dev/null || true); \
-        [ -b \"\$dev\" ] || dev=/dev/sdb; \
+        if [ ! -b \"\$dev\" ]; then \
+          echo \"ERROR: /dev/disk/by-id/google-${TPU_DATA_DISK} not found;\" \
+               'refusing to guess a device (unstable enumeration)' >&2; \
+          ls -l /dev/disk/by-id/ >&2 || true; exit 1; \
+        fi; \
         sudo mkdir -p /mnt/disks/persist; \
         mountpoint -q /mnt/disks/persist || \
           sudo mount -o ro,noload \"\$dev\" /mnt/disks/persist; \
-        ls /mnt/disks/persist >/dev/null"
+        if [ ! -e \"/mnt/disks/persist/${marker}\" ]; then \
+          echo \"ERROR: mounted ${TPU_DATA_DISK} but\" \
+               \"/mnt/disks/persist/${marker} is missing — wrong disk?\" \
+               '(set TPU_DATA_MARKER to the expected data dir)' >&2; \
+          exit 1; \
+        fi"
     else
       echo "note: TPU_DATA_DISK not set; skipping dataset-disk attach/mount" >&2
     fi

@@ -169,3 +169,81 @@ def test_flash_sharded_wrapper_matches_unsharded(mesh8):
 
     with axis_rules(mesh8):
         assert _flash_sharded(q, k, v, True) is None
+
+
+def test_flash_dropout_matches_hash_oracle():
+    """flash_attention_dropout vs a dense oracle built from the SAME
+    counter-based hash (dropout_mask_reference): identical forward and
+    q/k/v grads. The hash differs from jax.random.bernoulli by design —
+    the oracle shares it, so this is exact parity, not statistical."""
+    b, h, hkv, t, c = 2, 4, 2, 256, 16
+    rate = 0.2
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), b, h, hkv, t, c)
+    seed = jnp.int32(12345)
+
+    def oracle(q, k, v):
+        groups = h // hkv
+        qg = q.reshape(b, hkv, groups, t, c)
+        z = jnp.einsum("bkgqc,bkjc->bkgqj", qg, k,
+                       preferred_element_type=jnp.float32)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        z = jnp.where(mask, z, -1e30) / jnp.sqrt(c)
+        p = jax.nn.softmax(z, axis=-1)  # undropped softmax
+        keep = flash_mod.dropout_mask_reference(seed, b, h, t, rate)
+        keep = keep.reshape(b, hkv, groups, t, t)
+        pd = jnp.where(keep, p / (1.0 - rate), 0.0)
+        out = jnp.einsum("bkgqj,bkjc->bkgqc", pd.astype(v.dtype), v)
+        return out.reshape(b, h, t, c)
+
+    got = flash_mod.flash_attention_dropout(q, k, v, seed, rate, True, 128, 128)
+    want = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_mod.flash_attention_dropout(q, k, v, seed, rate, True, 128, 128)
+            ** 2
+        )
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(oracle(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, go, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), atol=2e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_dropout_mask_statistics_and_determinism():
+    t, rate = 256, 0.3
+    m1 = flash_mod.dropout_mask_reference(jnp.int32(7), 2, 3, t, rate)
+    m2 = flash_mod.dropout_mask_reference(jnp.int32(7), 2, 3, t, rate)
+    m3 = flash_mod.dropout_mask_reference(jnp.int32(8), 2, 3, t, rate)
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    assert not np.array_equal(np.asarray(m1), np.asarray(m3))
+    keep_rate = float(np.asarray(m1).mean())
+    assert abs(keep_rate - (1 - rate)) < 0.01, keep_rate
+    # per-head masks differ
+    assert not np.array_equal(np.asarray(m1[0, 0]), np.asarray(m1[0, 1]))
+
+
+def test_flash_dropout_through_dispatch():
+    """attention(impl='flash', dropout...) routes to the dropout kernel and
+    stays deterministic per key; rate=0 equals the plain kernel."""
+    from midgpt_tpu.ops.attention import attention
+
+    b, h, t, c = 2, 2, 128, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), b, h, h, t, c)
+    key = jax.random.PRNGKey(3)
+    o1 = attention(q, k, v, impl="flash", dropout_rate=0.25,
+                   dropout_key=key, deterministic=False)
+    o2 = attention(q, k, v, impl="flash", dropout_rate=0.25,
+                   dropout_key=key, deterministic=False)
+    o3 = attention(q, k, v, impl="flash", dropout_rate=0.25,
+                   dropout_key=jax.random.PRNGKey(4), deterministic=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert not np.array_equal(np.asarray(o1), np.asarray(o3))
+    plain = attention(q, k, v, impl="flash", dropout_rate=0.0)
+    assert not np.array_equal(np.asarray(o1), np.asarray(plain))

@@ -142,7 +142,7 @@ def test_chunked_xent_sequence_sharded_values_and_grads(mesh8):
     hs = jax.device_put(h, NamedSharding(mesh8, P(("replica", "fsdp"), "sequence")))
     ys = jax.device_put(y, NamedSharding(mesh8, P(("replica", "fsdp"), "sequence")))
 
-    for chunk in (16, 32, 48):  # 48 does not divide T/S=32 -> gcd 16
+    for chunk in (16, 32, 48):  # 48 > T/S=32 -> largest divisor fallback (32)
         def loss(h_, w_):
             with axis_rules(mesh8):
                 return chunked_softmax_xent(h_, w_, ys, chunk_t=chunk)
