@@ -220,7 +220,9 @@ def _zigzag_ring_body(q, k, v, axis_name: str, use_flash: bool):
 def _zigzag_order(t: int, s: int):
     """Gather indices re-laying a contiguous T axis into zigzag chunk
     order [0, 2S-1, 1, 2S-2, ...] (device i holds pair (i, 2S-1-i)), and
-    the inverse permutation."""
+    the inverse permutation. Oracle only: tests assert the shard-local
+    ppermute relayout below equals this index permutation
+    (tests/test_ring.py::test_zigzag_relayout_matches_index_oracle)."""
     import numpy as np
 
     tc = t // (2 * s)
@@ -229,6 +231,65 @@ def _zigzag_order(t: int, s: int):
         order += [i, 2 * s - 1 - i]
     idx = np.concatenate([np.arange(c * tc, (c + 1) * tc) for c in order])
     return idx, np.argsort(idx)
+
+
+def _zigzag_relayout_in(x, axis_name: str, s: int):
+    """Natural-order local rows (global half-chunks (2d, 2d+1) on device
+    d) -> the zigzag pair (d, 2S-1-d), via two bijective half-chunk
+    ppermutes + a parity slot-select. A global ``jnp.take`` over the
+    sharded T axis did this before — GSPMD lowered it to a FULL-sequence
+    all-gather of Q/K/V on every device (caught by the r4 HLO audit,
+    tests/test_hlo_collectives.py), defeating ring attention's O(T/S)
+    memory at its own front door. Here each device sends exactly two
+    half-chunks and receives two.
+
+    Half-chunk g's zigzag owner is t(g) = g if g < S else 2S-1-g; the two
+    preimages {d, 2S-1-d} of owner d always have opposite parity, so the
+    even-g halves form one device bijection and the odd-g halves another.
+    The even-g arrival lands in slot 0 exactly when d is even."""
+    d = jax.lax.axis_index(axis_name)
+    tc = x.shape[2] // 2
+    lo, hi = x[:, :, :tc], x[:, :, tc:]
+
+    def tgt(g: int) -> int:
+        return g if g < s else 2 * s - 1 - g
+
+    a = jax.lax.ppermute(
+        lo, axis_name, [(i, tgt(2 * i)) for i in range(s)]
+    )  # even-g halves
+    b = jax.lax.ppermute(
+        hi, axis_name, [(i, tgt(2 * i + 1)) for i in range(s)]
+    )  # odd-g halves
+    even_first = (d % 2 == 0)
+    first = jnp.where(even_first, a, b)
+    second = jnp.where(even_first, b, a)
+    return jnp.concatenate([first, second], axis=2)
+
+
+def _zigzag_relayout_out(y, axis_name: str, s: int):
+    """Inverse of ``_zigzag_relayout_in`` (the permutation transpose):
+    device d holds (g=d, g=2S-1-d); the even-g half goes to device
+    g_even/2's low slot, the odd-g half to (g_odd-1)/2's high slot."""
+    d = jax.lax.axis_index(axis_name)
+    tc = y.shape[2] // 2
+    slot0, slot1 = y[:, :, :tc], y[:, :, tc:]
+    even_first = (d % 2 == 0)
+    even_half = jnp.where(even_first, slot0, slot1)
+    odd_half = jnp.where(even_first, slot1, slot0)
+
+    def g_even(dd: int) -> int:
+        return dd if dd % 2 == 0 else 2 * s - 1 - dd
+
+    def g_odd(dd: int) -> int:
+        return dd if dd % 2 == 1 else 2 * s - 1 - dd
+
+    c = jax.lax.ppermute(
+        even_half, axis_name, [(i, g_even(i) // 2) for i in range(s)]
+    )
+    e = jax.lax.ppermute(
+        odd_half, axis_name, [(i, (g_odd(i) - 1) // 2) for i in range(s)]
+    )
+    return jnp.concatenate([c, e], axis=2)
 
 
 def ring_attention(
@@ -254,9 +315,10 @@ def ring_attention(
     schedule: "standard" (device i = chunk i; devices with later chunks do
     up to S times the work of device 0) or "zigzag" (device i = chunk pair
     (i, 2S-1-i); every hop is constant work — ~2x faster at large S). The
-    zigzag relayout is one static T-permutation before/after the ring
-    (GSPMD lowers it to an all-to-all); feeding data in zigzag order
-    upstream would remove even that."""
+    zigzag relayout runs INSIDE the shard_map as two half-chunk ppermutes
+    each way (r4: the old global jnp.take lowered to a full-T all-gather
+    of Q/K/V per device — caught by tests/test_hlo_collectives.py);
+    feeding data in zigzag order upstream would remove even that."""
     s = mesh.shape[axis_name]
     t = q.shape[2]
     assert t % s == 0, f"T={t} not divisible by sequence axis {s}"
@@ -287,18 +349,23 @@ def ring_attention(
     spec = P(b_axes if b_axes else None, h_axes if h_axes else None, axis_name, None)
 
     if schedule == "zigzag":
-        idx, inv = _zigzag_order(t, s)
-        qz, kz, vz = (jnp.take(x, idx, axis=2) for x in (q, k, v))
+        def zigzag_body(ql, kl, vl):
+            ql = _zigzag_relayout_in(ql, axis_name, s)
+            kl = _zigzag_relayout_in(kl, axis_name, s)
+            vl = _zigzag_relayout_in(vl, axis_name, s)
+            out = _zigzag_ring_body(
+                ql, kl, vl, axis_name=axis_name, use_flash=use_flash
+            )
+            return _zigzag_relayout_out(out, axis_name, s)
+
         fn = jax.shard_map(
-            functools.partial(
-                _zigzag_ring_body, axis_name=axis_name, use_flash=use_flash
-            ),
+            zigzag_body,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
             check_vma=False,
         )
-        return jnp.take(fn(qz, kz, vz), inv, axis=2)
+        return fn(q, k, v)
 
     assert schedule == "standard", f"unknown ring schedule {schedule!r}"
     fn = jax.shard_map(

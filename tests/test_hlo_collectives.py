@@ -120,8 +120,7 @@ def _shrunk(name: str):
     )
 
 
-def _compile_step(name: str) -> str:
-    cfg = _shrunk(name)
+def _compile_cfg(cfg):
     mesh = create_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg)
     state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
@@ -133,24 +132,34 @@ def _compile_step(name: str) -> str:
     return txt, mesh
 
 
+def _compile_step(name: str):
+    return _compile_cfg(_shrunk(name))
+
+
 def _local_batch(mesh) -> int:
     shape = dict(mesh.shape)
     return BATCH // (shape.get("replica", 1) * shape.get("fsdp", 1))
 
 
-def _assert_no_batch_gather(hlo: str, mesh):
-    """No all-gather over dim 0 of a [B_local, T, ...] activation."""
+def _local_t(mesh) -> int:
+    return BLOCK // dict(mesh.shape).get("sequence", 1)
+
+
+def _assert_no_batch_gather(colls, mesh):
+    """No all-gather over dim 0 of a [B_local, T_local, ...] activation."""
     b_local = _local_batch(mesh)
-    for kind, line, _, shapes, dims in _collectives(hlo):
+    t_local = _local_t(mesh)
+    for kind, line, _, shapes, dims in colls:
         if kind != "all-gather":
             continue
         for shape in shapes:
             # activations are rank>=3 [B, T, ...]; rank-2 gathers are FSDP
-            # param shards (legitimate), feature-dim gathers are TP
+            # param shards (legitimate), feature-dim gathers are TP. The
+            # sequence dim carries T_local on sequence-sharded meshes.
             if (
                 len(shape) >= 3
                 and 0 in dims
-                and shape[1] == BLOCK
+                and shape[1] in (t_local, BLOCK)
                 and shape[0] >= b_local
             ):
                 raise AssertionError(
@@ -163,12 +172,50 @@ def _assert_no_batch_gather(hlo: str, mesh):
 def test_sharded_config_has_no_batch_allgather(name):
     hlo, mesh = _compile_step(name)
     assert dict(mesh.shape)["tensor"] == 4  # the shipped FSDP x TP shape
-    _assert_no_batch_gather(hlo, mesh)
+    _assert_no_batch_gather(_collectives(hlo), mesh)
+
+
+@pytest.mark.slow
+def test_ring_config_permutes_instead_of_gathering_seq():
+    """A sequence-sharded ring-attention train step must move K/V with
+    collective-permutes (the ring hops), never by all-gathering the full
+    sequence onto every device — the anti-pattern ring attention exists
+    to avoid (SURVEY.md §5.7)."""
+    cfg = _shrunk("openwebtext")
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, attn_impl="ring"),
+        mesh=dataclasses.replace(
+            cfg.mesh, replica=1, fsdp=2, sequence=4, tensor=1
+        ),
+    )
+    hlo, mesh = _compile_cfg(cfg)
+
+    colls = _collectives(hlo)
+    assert any(k == "collective-permute" for k, *_ in colls), (
+        "no collective-permute found — the ring schedule is not in the "
+        "compiled step"
+    )
+    for kind, line, _, shapes, dims in colls:
+        if kind != "all-gather":
+            continue
+        for shape in shapes:
+            # no rank>=3 activation gather that reconstitutes the full T:
+            # a gathered dim (ANY position >= 1 — K/V sit at [B,H,T,C] with
+            # T at dim 2 inside attention) reaching full BLOCK size
+            if len(shape) >= 3 and any(
+                d >= 1 and d < len(shape) and shape[d] == BLOCK for d in dims
+            ):
+                raise AssertionError(
+                    f"full-sequence all-gather of an activation:\n{line}"
+                )
+    _assert_no_batch_gather(colls, mesh)
 
 
 @pytest.mark.slow
 def test_multislice_dcn_contract():
     hlo, mesh = _compile_step("openwebtext_xl_multislice")
+    colls = _collectives(hlo)
     shape = dict(mesh.shape)
     assert shape["replica"] == 2
 
@@ -187,7 +234,7 @@ def test_multislice_dcn_contract():
 
     b_local = _local_batch(mesh)
     saw_cross_reduce = False
-    for kind, line, groups, shapes, _ in _collectives(hlo):
+    for kind, line, groups, shapes, _ in colls:
         if not crosses(groups):
             continue
         # DP-only over DCN: the only traffic allowed across slices is
@@ -206,4 +253,4 @@ def test_multislice_dcn_contract():
         "divergently (DP sync missing from the compiled step)"
     )
 
-    _assert_no_batch_gather(hlo, mesh)
+    _assert_no_batch_gather(colls, mesh)
