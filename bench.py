@@ -115,10 +115,49 @@ def _measure(cfg, state, chain, n_steps: int = 10, repeats: int = 3):
     return tokens_per_sec, 1e3 * step_s, state
 
 
+def _backend_watchdog(timeout_s: float = 600.0):
+    """Fail LOUDLY if backend init hangs (a wedged axon relay blocks
+    inside the C++ client forever — r4 post-mortem; a hung bench run is
+    worse for the driver than a failed one). Cancelled once devices are
+    visible.
+
+    Tradeoff, explicit: exiting tears down a possibly-in-flight relay RPC,
+    which the r3/r4 post-mortems show can wedge the relay for the rest of
+    the round. Accepted here because (a) normal init is 20-40 s and the
+    timeout is 600 s — a healthy-but-slow init never triggers it, and
+    (b) the alternative is the driver's whole bench stage hanging on a
+    relay that is already gone."""
+    import os
+    import sys
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps({
+                    "metric": "bench_error",
+                    "value": 0,
+                    "unit": "none",
+                    "vs_baseline": 0,
+                    "error": f"backend init exceeded {timeout_s:.0f}s "
+                             "(wedged TPU relay?)",
+                }),
+                flush=True,
+            )
+            sys.stderr.write("bench watchdog: backend init hung; exiting\n")
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done
+
+
 def main() -> None:
     from midgpt_tpu.utils.metrics import flops_per_token, mfu
 
     t_start = time.perf_counter()
+    _init_done = _backend_watchdog()
 
     # persistent executable cache: repeat runs (and the fallback ladder)
     # skip recompiles
@@ -128,7 +167,19 @@ def main() -> None:
     except Exception:
         pass
 
-    n_dev = jax.device_count()
+    try:
+        n_dev = jax.device_count()
+    except Exception as e:  # relay dead: fail fast WITH the JSON contract
+        _init_done.set()
+        print(
+            json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "none",
+                "vs_baseline": 0, "error": f"backend init failed: {e}"[:400],
+            }),
+            flush=True,
+        )
+        raise SystemExit(3)
+    _init_done.set()  # devices visible — cancel the init watchdog
 
     # --- headline: flagship-family (openwebtext_xl per-layer shape) ------
     # ladder fastest-measured first (PERF.md r3 with the combined-backward

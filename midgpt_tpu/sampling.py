@@ -94,7 +94,7 @@ def generate(
     of KV decode on TPU (PERF.md r4). The joint softmax over both parts is
     exact; chunking changes performance, not semantics."""
     assert sliding in ("exact", "kv"), f"unknown sliding mode {sliding!r}"
-    assert chunk_len >= 1
+    assert chunk_len >= 1, f"chunk_len must be >= 1, got {chunk_len}"
     b, p = prompt.shape
     cfg = model.config
     if p > cfg.block_size:

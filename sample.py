@@ -65,6 +65,16 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top_k", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    def _positive_int(v: str) -> int:
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return n
+
+    ap.add_argument(
+        "--chunk_len", type=_positive_int, default=64,
+        help="decode chunk length (recent-KV buffer rows; perf knob)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -134,6 +144,7 @@ def main() -> None:
         mesh=mesh,
         temperature=args.temperature,
         top_k=args.top_k,
+        chunk_len=args.chunk_len,
     )
     toks = sampler(model, jnp.asarray(prompt), jax.random.PRNGKey(args.seed))
     for i in range(args.num_samples):
