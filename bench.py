@@ -115,6 +115,18 @@ def _measure(cfg, state, chain, n_steps: int = 10, repeats: int = 3):
     return tokens_per_sec, 1e3 * step_s, state
 
 
+def _emit_bench_error(msg: str) -> None:
+    """The driver parses bench output mechanically — every failure mode
+    must still print the one-JSON-line contract."""
+    print(
+        json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "none",
+            "vs_baseline": 0, "error": msg[:400],
+        }),
+        flush=True,
+    )
+
+
 def _backend_watchdog(timeout_s: float = 600.0):
     """Fail LOUDLY if backend init hangs (a wedged axon relay blocks
     inside the C++ client forever — r4 post-mortem; a hung bench run is
@@ -135,16 +147,8 @@ def _backend_watchdog(timeout_s: float = 600.0):
 
     def watch():
         if not done.wait(timeout_s):
-            print(
-                json.dumps({
-                    "metric": "bench_error",
-                    "value": 0,
-                    "unit": "none",
-                    "vs_baseline": 0,
-                    "error": f"backend init exceeded {timeout_s:.0f}s "
-                             "(wedged TPU relay?)",
-                }),
-                flush=True,
+            _emit_bench_error(
+                f"backend init exceeded {timeout_s:.0f}s (wedged TPU relay?)"
             )
             sys.stderr.write("bench watchdog: backend init hung; exiting\n")
             os._exit(3)
@@ -171,13 +175,7 @@ def main() -> None:
         n_dev = jax.device_count()
     except Exception as e:  # relay dead: fail fast WITH the JSON contract
         _init_done.set()
-        print(
-            json.dumps({
-                "metric": "bench_error", "value": 0, "unit": "none",
-                "vs_baseline": 0, "error": f"backend init failed: {e}"[:400],
-            }),
-            flush=True,
-        )
+        _emit_bench_error(f"backend init failed: {e}")
         raise SystemExit(3)
     _init_done.set()  # devices visible — cancel the init watchdog
 

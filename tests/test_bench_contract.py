@@ -1,0 +1,63 @@
+"""bench.py's one-JSON-line contract must survive a dead TPU backend:
+the driver records bench output mechanically, so a wedged/killed relay
+has to produce a parseable bench_error record, never a bare traceback or
+a hang (PERF.md r4 relay post-mortem)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_json_error_on_dead_backend():
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r}); import bench; bench.main()"
+    )
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        # a platform name that exists on NO machine: init raises fast
+        # everywhere (a real platform name could init on target hardware
+        # and run the actual benchmark ladder from inside the test)
+        "JAX_PLATFORMS": "no_such_backend",
+        "XLA_FLAGS": "",
+        "PALLAS_AXON_POOL_IPS": "",
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert r.returncode == 3, (r.returncode, r.stderr[-400:])
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout[-400:]
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bench_error"
+    assert "error" in rec
+
+
+def test_bench_watchdog_fires_on_hung_init():
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r}); import bench, time; "
+        "bench._backend_watchdog(1.0); time.sleep(30); print('NOT_REACHED')"
+    )
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "PALLAS_AXON_POOL_IPS": "",
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+    assert r.returncode == 3
+    assert "NOT_REACHED" not in r.stdout
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "bench_error"
