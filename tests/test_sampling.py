@@ -5,6 +5,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.models.gpt import GPT, KVCache, decode_step, prefill
@@ -153,33 +154,32 @@ def test_batched_prefill_matches_stepwise_oracle():
     )
 
 
-import pytest
-
-
 @pytest.mark.parametrize(
-    "r_len,window",
+    "r_len,window,kv_heads",
     [
-        (4, 16),  # normal: chunks shorter than the window
-        (16, 8),  # chunk LONGER than the window: recent rows must evict
-                  # mid-chunk too (r4 review finding — mask_rec lower bound)
+        (4, 16, None),  # normal: chunks shorter than the window
+        (16, 8, None),  # chunk LONGER than the window: recent rows must
+                        # evict mid-chunk too (r4 review — mask_rec bound)
+        (4, 16, 2),     # GQA (llama-family serving shape)
     ],
 )
-def test_chunked_decode_matches_decode_step_oracle(r_len, window):
+def test_chunked_decode_matches_decode_step_oracle(r_len, window, kv_heads):
     """Teacher-forced logits parity: the chunked recent-buffer decode path
     (decode_step_recent + merge_recent, the serving hot path) must match
     the per-token decode_step oracle at every position — including across
-    chunk merges, ring wrap, and sliding-window eviction."""
+    chunk merges, ring wrap, sliding-window eviction, and GQA."""
     from midgpt_tpu.models.gpt import decode_step_recent, merge_recent
 
-    model = GPT.init(jax.random.PRNGKey(0), CFG)
+    cfg = dataclasses.replace(CFG, n_kv_head=kv_heads)  # None = MHA default
+    model = GPT.init(jax.random.PRNGKey(0), cfg)
     p, n_steps = 5, 17
     total = p + n_steps
     tokens = jax.random.randint(
-        jax.random.PRNGKey(4), (2, total), 0, CFG.vocab_size
+        jax.random.PRNGKey(4), (2, total), 0, cfg.vocab_size
     )
 
     # oracle: plain ring decode at exactly `window` slots
-    cache_o = KVCache.init(CFG, batch=2, max_len=window, dtype=jnp.float32)
+    cache_o = KVCache.init(cfg, batch=2, max_len=window, dtype=jnp.float32)
     _, cache_o = prefill(model, tokens[:, :p], cache_o)
     oracle = []
     for t in range(p, total):
@@ -191,13 +191,13 @@ def test_chunked_decode_matches_decode_step_oracle(r_len, window):
 
     # chunked: padded ring + recent buffers, merged every r_len steps
     wp = -(-window // r_len) * r_len
-    cache = KVCache.init(CFG, batch=2, max_len=wp, dtype=jnp.float32)
+    cache = KVCache.init(cfg, batch=2, max_len=wp, dtype=jnp.float32)
     _, cache = prefill(model, tokens[:, :p], cache)
     got = []
     base = p
     while base < total:
         clen = min(r_len - base % r_len, total - base)
-        rshape = (CFG.n_layer, 2, CFG.kv_heads, r_len, CFG.head_dim)
+        rshape = (cfg.n_layer, 2, cfg.kv_heads, r_len, cfg.head_dim)
         rk = jnp.zeros(rshape, jnp.float32)
         rv = jnp.zeros(rshape, jnp.float32)
         for r in range(clen):
