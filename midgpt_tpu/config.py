@@ -157,8 +157,9 @@ class ExperimentConfig:
     seed: int = 0
     data_seed: int = 1234  # seeded loader (fixes train.py:60 nondeterminism)
     # T-chunk size for chunked cross-entropy (ops/loss.py): the [B,T,V] f32
-    # logits never materialize. None = dense loss (reference parity path);
-    # ignored (dense used) when the sequence axis is sharded.
+    # logits never materialize. None = dense loss (reference parity path).
+    # Works under a sharded sequence axis too: chunking runs shard-local
+    # inside shard_map (train.py:129-140), so each rank chunks its own slice.
     loss_chunk: tp.Optional[int] = None
     # unroll the chunk scan: kills the while-loop overhead (carried [D,V]
     # dW re-read/written per backward iteration) while keeping per-chunk

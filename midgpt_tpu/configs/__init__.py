@@ -46,6 +46,9 @@ def openwebtext() -> ExperimentConfig:
         batch_size=2048, g_accum_iters=16,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        # fixed eval sweep: same eval batches every interval -> comparable
+        # curves, and the counter-based loader makes it free (VERDICT r4)
+        eval_fixed=True,
         loss_chunk=256, loss_chunk_unroll=True,  # measured best (PERF.md)
     )
 
@@ -78,6 +81,7 @@ def openwebtext_xl() -> ExperimentConfig:
         batch_size=1024, g_accum_iters=1,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        eval_fixed=True,
         loss_chunk=512, loss_chunk_unroll=True,  # measured best (PERF.md)
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
     )
@@ -111,6 +115,7 @@ def llama_7b() -> ExperimentConfig:
         batch_size=512, g_accum_iters=1,
         beta2=0.95, weight_decay=1e-4,
         eval_interval=1000,
+        eval_fixed=True,
         loss_chunk=512, loss_chunk_unroll=True,  # measured best (PERF.md)
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
     )

@@ -176,13 +176,24 @@ class Attention:
                 assert mesh is not None, (
                     "attn_impl='ring' requires running inside axis_rules(mesh)"
                 )
-                assert self.dropout_rate == 0.0 or deterministic, (
-                    "ring attention does not support attention dropout"
-                )
                 schedule = self.ring_schedule
                 if schedule == "zigzag" and t % (2 * mesh.shape["sequence"]):
                     schedule = "standard"  # zigzag needs T | 2S
-                out = ring_attention(q, k, v, mesh, schedule=schedule)
+                if self.dropout_rate > 0.0 and not deterministic:
+                    # in-hop counter-hash dropout at global coordinates
+                    # (ring.py); zigzag interleaves half-chunks, which the
+                    # scalar hash offsets can't express — degrade to the
+                    # standard schedule (r5; the only dropout configs are
+                    # the small shakespeare family)
+                    seed = jax.random.randint(
+                        adrop_key, (), -(2**31), 2**31 - 1, dtype=jnp.int32
+                    )
+                    out = ring_attention(
+                        q, k, v, mesh, schedule="standard",
+                        dropout_rate=self.dropout_rate, dropout_seed=seed,
+                    )
+                else:
+                    out = ring_attention(q, k, v, mesh, schedule=schedule)
             else:
                 out = attention(
                     q,

@@ -105,6 +105,35 @@ def _dropout_keep_block(
     return u24 < jnp.int32(int(keep * (1 << 24)))
 
 
+def _seed_vec(seed, row_off, col_off, bh_off=None) -> Array:
+    """[4] int32 SMEM payload: dropout seed + GLOBAL anchors of this
+    call's local (0, 0, 0, 0): score row/col offsets and the flat
+    ``batch * H_total + head`` base. Anchors let a ring-attention hop or
+    a batch/head-sharded call (parallel/ring.py) regenerate the exact
+    mask a single-device call would use at the same global coordinates —
+    sharded dropout is bit-identical to dense flash dropout."""
+    z = jnp.zeros((), jnp.int32)
+    r = z if row_off is None else jnp.asarray(row_off, jnp.int32).reshape(())
+    c = z if col_off is None else jnp.asarray(col_off, jnp.int32).reshape(())
+    bh = z if bh_off is None else jnp.asarray(bh_off, jnp.int32).reshape(())
+    return jnp.stack([
+        jnp.asarray(seed, jnp.int32).reshape(()), r, c, bh,
+    ])
+
+
+def _struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """pallas_call out_shape inheriting the manual-axes vma of ``like``.
+
+    Inside a ``check_vma=True`` shard_map region (the PP stage region,
+    parallel/pipeline.py:169, and the data/TP wrap in ops/attention.py) a
+    plain ShapeDtypeStruct fails pallas type-checking; carrying the input
+    operand's vma keeps the output varying over the same manual axes."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _act_spec(rows: int, c: int, row_fn, head_fn):
     """BlockSpec for a q/k/v/o/do activation carrying ``rows`` sequence rows.
 
@@ -133,7 +162,11 @@ def _fwd_kernel(
     iq, ik = pl.program_id(2), pl.program_id(3)
     # program_id must bind OUTSIDE pl.when bodies (no interpret lowering
     # inside the cond); the flat batch-head id seeds the dropout hash
-    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
+    bh = (
+        seed_ref[3] + pl.program_id(0) * n_head + pl.program_id(1)
+        if keep is not None
+        else None
+    )
 
     @pl.when(ik == 0)
     def _init():
@@ -173,7 +206,8 @@ def _fwd_kernel(
         p_acc = p
         if keep is not None:
             mask = _dropout_keep_block(
-                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+                seed_ref[0], bh,
+                seed_ref[1] + iq * bq, seed_ref[2] + ik * bk, bq, bk, keep,
             )
             p_acc = jnp.where(mask, p * (1.0 / keep), 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -197,6 +231,8 @@ def _fwd_kernel(
 def _flash_forward(
     q: Array, k: Array, v: Array, *, causal: bool, bq: int, bk: int,
     keep: tp.Optional[float] = None, seed: tp.Optional[Array] = None,
+    row_off: tp.Optional[Array] = None, col_off: tp.Optional[Array] = None,
+    bh_off: tp.Optional[Array] = None, n_head_total: tp.Optional[int] = None,
 ) -> tp.Tuple[Array, Array]:
     b, h, t, c = q.shape
     _, hkv, s, _ = k.shape
@@ -208,7 +244,7 @@ def _flash_forward(
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        keep=keep, n_head=h,
+        keep=keep, n_head=n_head_total or h,
     )
     row_q = lambda b_, h_, iq, ik: iq  # noqa: E731
     # trimmed causal grid: masked (ik > iq) steps are compute-skipped
@@ -228,7 +264,7 @@ def _flash_forward(
     operands = (q, k, v)
     if keep is not None:
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
-        operands = (seed.reshape(1).astype(jnp.int32),) + operands
+        operands = (_seed_vec(seed, row_off, col_off, bh_off),) + operands
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -238,8 +274,8 @@ def _flash_forward(
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+            _struct((b, h, t, c), q.dtype, q),
+            _struct((b, h, t, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, c), jnp.float32),
@@ -269,7 +305,11 @@ def _bwd_dq_kernel(
     else:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     iq, ik = pl.program_id(2), pl.program_id(3)
-    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
+    bh = (
+        seed_ref[3] + pl.program_id(0) * n_head + pl.program_id(1)
+        if keep is not None
+        else None
+    )
 
     @pl.when(ik == 0)
     def _init():
@@ -304,7 +344,8 @@ def _bwd_dq_kernel(
             # with the SAME regenerated mask (delta already absorbs out's
             # dropped entries — it is rowsum(do * out))
             mask = _dropout_keep_block(
-                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+                seed_ref[0], bh,
+                seed_ref[1] + iq * bq, seed_ref[2] + ik * bk, bq, bk, keep,
             )
             dp = jnp.where(mask, dp * (1.0 / keep), 0.0)
         ds = p * (dp - delta) * scale
@@ -332,7 +373,11 @@ def _bwd_dkv_kernel(
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
          dk_acc, dv_acc) = refs
     ik, iq = pl.program_id(2), pl.program_id(3)
-    bh = pl.program_id(0) * n_head + pl.program_id(1) if keep is not None else None
+    bh = (
+        seed_ref[3] + pl.program_id(0) * n_head + pl.program_id(1)
+        if keep is not None
+        else None
+    )
 
     @pl.when(iq == (ik if causal else 0))
     def _init():
@@ -368,7 +413,8 @@ def _bwd_dkv_kernel(
             # NOTE transposed grid: this kernel's block rows start at
             # iq * bq (grid is (b, h, ik, iq))
             mask = _dropout_keep_block(
-                seed_ref[0], bh, iq * bq, ik * bk, bq, bk, keep,
+                seed_ref[0], bh,
+                seed_ref[1] + iq * bq, seed_ref[2] + ik * bk, bq, bk, keep,
             )
             inv = 1.0 / keep
             p_v = jnp.where(mask, p * inv, 0.0)
@@ -395,6 +441,8 @@ def _flash_backward(
     q: Array, k: Array, v: Array, out: Array, lse: Array, do: Array,
     *, causal: bool, bq: int, bk: int, dlse: tp.Optional[Array] = None,
     keep: tp.Optional[float] = None, seed: tp.Optional[Array] = None,
+    row_off: tp.Optional[Array] = None, col_off: tp.Optional[Array] = None,
+    bh_off: tp.Optional[Array] = None, n_head_total: tp.Optional[int] = None,
 ) -> tp.Tuple[Array, Array, Array]:
     b, h, t, c = q.shape
     hkv = k.shape[1]
@@ -405,7 +453,7 @@ def _flash_backward(
     seed_ops: tp.Tuple[Array, ...] = ()
     seed_specs: tp.List[tp.Any] = []
     if keep is not None:
-        seed_ops = (seed.reshape(1).astype(jnp.int32),)
+        seed_ops = (_seed_vec(seed, row_off, col_off, bh_off),)
         seed_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
 
     # delta_i = rowsum(dO * O) — cheap elementwise, fused by XLA; stored
@@ -434,7 +482,7 @@ def _flash_backward(
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            keep=keep, n_head=h,
+            keep=keep, n_head=n_head_total or h,
         ),
         grid=(b, h, nq, nk),
         in_specs=seed_specs + [
@@ -446,7 +494,7 @@ def _flash_backward(
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_specs=_act_spec(bq, c, row_q34, q_head),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, c), q.dtype),
+        out_shape=_struct((b, h, t, c), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, c), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -457,7 +505,7 @@ def _flash_backward(
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            keep=keep, n_head=h,
+            keep=keep, n_head=n_head_total or h,
         ),
         grid=(b, h, nk, nq),
         in_specs=seed_specs + [
@@ -479,8 +527,8 @@ def _flash_backward(
             _act_spec(bk, c, row_k43, q_head),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, c), k.dtype),
-            jax.ShapeDtypeStruct((b, h, t, c), v.dtype),
+            _struct((b, h, t, c), k.dtype, q),
+            _struct((b, h, t, c), v.dtype, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, c), jnp.float32),
@@ -520,7 +568,69 @@ def flash_attention(
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash_lse_core(
+    q: Array,
+    k: Array,
+    v: Array,
+    seed: Array,      # [] int32 (ignored when rate == 0.0)
+    row_off: Array,   # [] int32 — global row of this call's (0,0) score
+    col_off: Array,   # [] int32 — global col of this call's (0,0) score
+    bh_off: Array,    # [] int32 — global batch*H_total + head of local (0,0)
+    rate: float,
+    causal: bool,
+    block_q: tp.Optional[int],
+    block_k: tp.Optional[int],
+    n_head_total: tp.Optional[int],
+) -> tp.Tuple[Array, Array]:
+    """Single VJP pair behind every flash entry point: (out, lse) with a
+    differentiable lse (cotangent folds into the backward as
+    ``delta - dlse``), optional in-kernel dropout (rate > 0), and global
+    score-coordinate offsets so ring hops reproduce the exact
+    single-device mask (see _seed_vec)."""
+    keep = None if rate == 0.0 else 1.0 - rate
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k,
+        keep=keep, seed=seed, row_off=row_off, col_off=col_off,
+        bh_off=bh_off, n_head_total=n_head_total,
+    )
+    return out, lse[..., 0]
+
+
+def _core_vjp_fwd(
+    q, k, v, seed, row_off, col_off, bh_off,
+    rate, causal, block_q, block_k, n_head_total,
+):
+    keep = None if rate == 0.0 else 1.0 - rate
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, bq=block_q, bk=block_k,
+        keep=keep, seed=seed, row_off=row_off, col_off=col_off,
+        bh_off=bh_off, n_head_total=n_head_total,
+    )
+    return (out, lse[..., 0]), (
+        q, k, v, seed, row_off, col_off, bh_off, out, lse,
+    )
+
+
+def _core_vjp_bwd(rate, causal, block_q, block_k, n_head_total, residuals, cts):
+    q, k, v, seed, row_off, col_off, bh_off, out, lse = residuals
+    do, dlse = cts
+    keep = None if rate == 0.0 else 1.0 - rate
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, do,
+        causal=causal, bq=block_q, bk=block_k, dlse=dlse[..., None],
+        keep=keep, seed=seed, row_off=row_off, col_off=col_off,
+        bh_off=bh_off, n_head_total=n_head_total,
+    )
+    return dq, dk, dv, None, None, None, None
+
+
+_flash_lse_core.defvjp(_core_vjp_fwd, _core_vjp_bwd)
+
+def _z() -> Array:
+    return jnp.zeros((), jnp.int32)
+
+
 def flash_attention_lse(
     q: Array,
     k: Array,
@@ -535,26 +645,39 @@ def flash_attention_lse(
     backward kernels as ``delta - dlse`` (see _flash_backward) — which is
     what lets ring attention (midgpt_tpu.parallel.ring) run this kernel
     per hop and still autodiff through the streaming LSE merge."""
-    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
-    return out, lse[..., 0]
-
-
-def _lse_vjp_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, causal=causal, bq=block_q, bk=block_k)
-    return (out, lse[..., 0]), (q, k, v, out, lse)
-
-
-def _lse_vjp_bwd(causal, block_q, block_k, residuals, cts):
-    q, k, v, out, lse = residuals
-    do, dlse = cts
-    dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, do,
-        causal=causal, bq=block_q, bk=block_k, dlse=dlse[..., None],
+    return _flash_lse_core(
+        q, k, v, _z(), _z(), _z(), _z(), 0.0, causal, block_q, block_k, None
     )
-    return dq, dk, dv
 
 
-flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
+def flash_attention_dropout_lse(
+    q: Array,
+    k: Array,
+    v: Array,
+    seed: Array,
+    rate: float,
+    causal: bool = True,
+    block_q: tp.Optional[int] = None,
+    block_k: tp.Optional[int] = None,
+    row_off: tp.Optional[Array] = None,
+    col_off: tp.Optional[Array] = None,
+    bh_off: tp.Optional[Array] = None,
+    n_head_total: tp.Optional[int] = None,
+) -> tp.Tuple[Array, Array]:
+    """(out, lse) flash attention with in-kernel dropout AND global score
+    offsets — the ring-attention hop entry (parallel/ring.py): lse stays
+    differentiable through the streaming merge, and (row_off, col_off)
+    anchor the hop's mask in GLOBAL coordinates so the full ring pass
+    drops exactly the same (head, row, col) set a single-device call
+    would."""
+    z = _z()
+    return _flash_lse_core(
+        q, k, v, seed,
+        z if row_off is None else row_off,
+        z if col_off is None else col_off,
+        z if bh_off is None else bh_off,
+        rate, causal, block_q, block_k, n_head_total,
+    )
 
 
 def flash_attention_reference(q, k, v, causal=True):
@@ -569,7 +692,6 @@ def flash_attention_reference(q, k, v, causal=True):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def flash_attention_dropout(
     q: Array,
     k: Array,
@@ -592,32 +714,11 @@ def flash_attention_dropout(
     The mask stream differs from naive_attention's jax.random.bernoulli
     (different PRNG), so parity tests compare against an oracle built from
     dropout_mask_reference — same hash, dense evaluation."""
-    out, _ = _flash_forward(
-        q, k, v, causal=causal, bq=block_q, bk=block_k,
-        keep=1.0 - rate, seed=seed,
+    out, _ = _flash_lse_core(
+        q, k, v, jnp.asarray(seed, jnp.int32).reshape(()), _z(), _z(), _z(),
+        rate, causal, block_q, block_k, None,
     )
     return out
-
-
-def _dropout_vjp_fwd(q, k, v, seed, rate, causal, block_q, block_k):
-    out, lse = _flash_forward(
-        q, k, v, causal=causal, bq=block_q, bk=block_k,
-        keep=1.0 - rate, seed=seed,
-    )
-    return out, (q, k, v, seed, out, lse)
-
-
-def _dropout_vjp_bwd(rate, causal, block_q, block_k, residuals, do):
-    q, k, v, seed, out, lse = residuals
-    dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, do,
-        causal=causal, bq=block_q, bk=block_k,
-        keep=1.0 - rate, seed=seed,
-    )
-    return dq, dk, dv, None
-
-
-flash_attention_dropout.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
 
 
 def dropout_mask_reference(

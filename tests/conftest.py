@@ -39,3 +39,46 @@ def pallas_interpret(monkeypatch):
         pl, "pallas_call", functools.partial(pl.pallas_call, interpret=True)
     )
     yield
+
+
+# ---------------------------------------------------------------------------
+# Smoke tier (r5, VERDICT r4 Weak #9): `pytest -m smoke` runs the
+# oracle-parity + contract core in ~2 min so the build loop doesn't pay the
+# full suite's ~25 min per iteration. The full suite stays the round gate.
+# ---------------------------------------------------------------------------
+
+_SMOKE_ALL = {
+    "test_bench_contract",
+    "test_layers",
+    "test_sharding",
+    "test_metrics",
+    "test_gcs_paths",
+    "test_data",
+    "test_auto_knobs",
+}
+_SMOKE_TESTS = {
+    "test_loss": {"test_chunked_xent_matches_dense_value_and_grads"},
+    "test_flash": {
+        "test_flash_forward_matches_naive",
+        "test_flash_grad_matches_naive",
+        "test_flash_dropout_matches_hash_oracle",
+    },
+    "test_ring": {
+        "test_ring_matches_full_attention",
+        "test_ring_dropout_matches_single_device_mask",
+    },
+    "test_model": {
+        "test_batched_forward_matches_reference_math",
+        "test_causality",
+    },
+    "test_pipeline": {"test_pipeline_forward_matches_sequential"},
+    "test_sampling": {"test_decode_matches_full_forward"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        name = item.name.split("[", 1)[0]
+        if mod in _SMOKE_ALL or name in _SMOKE_TESTS.get(mod, ()):
+            item.add_marker(pytest.mark.smoke)

@@ -273,3 +273,38 @@ def test_gpt_pp_with_dropout():
     assert l_d1 == l_d1_again  # deterministic per key
     assert l_d1 != l_d2  # keys actually reach the dropout masks
     assert l_d1 != l_det  # dropout actually perturbs the forward
+
+
+def test_gpt_pp_flash_runs_at_parity(pallas_interpret):
+    """Flash attention inside pipeline stages (ADVICE r4): the stage region
+    is check_vma=True, so the kernel's out_shapes must carry the operands'
+    vma (ops/flash._struct) for pallas to type-check at all — this is the
+    regression test for that. The data-axis shard_map wrap does NOT engage
+    in there (Shardy rejects the nesting; see _flash_sharded's docstring),
+    so this checks the bare stage-local kernel lowers and stays at parity
+    on a PP x FSDP x TP mesh."""
+    import numpy as np
+
+    from midgpt_tpu.config import MeshConfig, ModelConfig
+
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 128, size=(1, 8, 128), dtype=np.int32)
+    y = rng.integers(0, 128, size=(1, 8, 128), dtype=np.int32)
+
+    def cfgm(impl):
+        return ModelConfig(
+            block_size=128, vocab_size=128, n_layer=2, n_head=4, n_embd=128,
+            dropout=0.0, attn_impl=impl, remat="none",
+        )
+
+    loss_pp_flash, _ = _run_gpt_step(
+        cfgm("flash"),
+        MeshConfig(pipeline=2, replica=1, fsdp=2, sequence=1, tensor=2),
+        8, x, y,
+    )
+    loss_plain, _ = _run_gpt_step(
+        cfgm("naive"),
+        MeshConfig(pipeline=1, replica=1, fsdp=1, sequence=1, tensor=1),
+        1, x, y,
+    )
+    np.testing.assert_allclose(loss_pp_flash, loss_plain, rtol=5e-4)
