@@ -36,7 +36,7 @@ BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
 
 def _run_config(
     remat: str, batch: int, base: str = "openwebtext", n_layer=None,
-    loss_chunk: int = 256,
+    loss_chunk: int = 256, block_size=None,
 ):
     """Build state + step for one candidate config; returns a timing
     closure. Raises on compile/alloc failure (caller falls back)."""
@@ -51,6 +51,10 @@ def _run_config(
     if n_layer is not None:
         cfg = dataclasses.replace(
             cfg, model=dataclasses.replace(cfg.model, n_layer=n_layer)
+        )
+    if block_size is not None:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, block_size=block_size)
         )
     cfg = dataclasses.replace(
         cfg,
@@ -414,6 +418,72 @@ def main() -> None:
             exc.__traceback__ = None
             record["llama_error"] = repr(exc)[:120]
             lcfg = lstate = lchain = None
+            gc.collect()
+
+    # --- auxiliary rung: long context (T=4096/8192, 124M family) ---------
+    # flash + chunked loss at T >> the kernels' 1024 block cap: exercises
+    # the multi-block backward path and the O(T) activation story that
+    # ring attention + chunked xent exist for (VERDICT r4 Next #5). The
+    # 8192 attempt is budget-gated like decode.
+    for lc_t, lc_batch, lc_remat in (
+        (4096, 4 * n_dev, "none"),
+        (4096, 2 * n_dev, "none"),
+        (4096, 4 * n_dev, "full"),
+    ):
+        try:
+            ccfg, cstate, cchain, cmk = _run_config(
+                lc_remat, lc_batch, base="openwebtext",
+                block_size=lc_t, loss_chunk=512,
+            )
+            ctps, cstep_ms, cstate, _cmode = _rung_measure(
+                ccfg, cstate, cchain, cmk
+            )
+            cmfu = mfu(ctps, ccfg.model, n_dev)
+            record.update(
+                {
+                    "long_ctx_metric": f"openwebtext_124m_T{lc_t}_train_mfu",
+                    "long_ctx_mfu": round(cmfu, 4),
+                    "long_ctx_t": lc_t,
+                    "long_ctx_tokens_per_sec_per_chip": round(ctps / n_dev, 1),
+                    "long_ctx_step_ms": round(cstep_ms, 1),
+                    "long_ctx_remat": lc_remat,
+                    "long_ctx_batch_per_chip": ccfg.batch_size // n_dev,
+                }
+            )
+            record.pop("long_ctx_error", None)
+            del cstate, cchain
+            gc.collect()
+            break
+        except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
+            exc.__traceback__ = None
+            record["long_ctx_error"] = repr(exc)[:120]
+            ccfg = cstate = cchain = None
+            gc.collect()
+
+    if time.perf_counter() - t_start < 240 and "long_ctx_mfu" in record:
+        try:
+            ccfg, cstate, cchain, cmk = _run_config(
+                "none", 1 * n_dev, base="openwebtext",
+                block_size=8192, loss_chunk=512,
+            )
+            ctps, cstep_ms, cstate, _cmode = _rung_measure(
+                ccfg, cstate, cchain, cmk
+            )
+            record.update(
+                {
+                    "long_ctx8k_mfu": round(mfu(ctps, ccfg.model, n_dev), 4),
+                    "long_ctx8k_tokens_per_sec_per_chip": round(
+                        ctps / n_dev, 1
+                    ),
+                    "long_ctx8k_step_ms": round(cstep_ms, 1),
+                }
+            )
+            del cstate, cchain
+            gc.collect()
+        except Exception as exc:  # noqa: BLE001
+            exc.__traceback__ = None
+            record["long_ctx8k_error"] = repr(exc)[:120]
+            ccfg = cstate = cchain = None
             gc.collect()
 
     # --- auxiliary rung: serving (prefill + KV-cached decode) ------------

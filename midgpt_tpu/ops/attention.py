@@ -183,8 +183,8 @@ def attention(
     """Dispatch between implementations.
 
     impl:
-      auto  - flash on TPU when shapes allow and no attention dropout,
-              else naive
+      auto  - flash on TPU when shapes allow (dropout included: the
+              kernels regenerate the mask in-kernel), else naive
       naive - reference O(T^2) math (oracle)
       flash - Pallas blockwise online-softmax kernel
     """
