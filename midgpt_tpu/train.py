@@ -378,10 +378,17 @@ def resolve_auto_knobs(cfg: ExperimentConfig, n_devices: int,
         state_shards = max(1, fsdp_sz * tensor_sz)
         fill = (state_bytes / state_shards + act_none) / hbm_bytes
         # calibration on a 16G v5e (PERF.md r3): fill 0.77 (llama-L2 B=8)
-        # runs at remat=none; fill 0.80 (124M B=48) fails to compile
-        if fill <= 0.78:
+        # runs at remat=none; fill 0.80 (124M B=48) fails to compile.
+        # On OTHER chip classes (HBM far from the calibrated 16G) the
+        # thresholds are an unmeasured extrapolation — lean OPTIMISTIC
+        # there (+0.06 band): the first-step OOM step-down ladder
+        # (exec_step) corrects a too-aggressive pick at the cost of one
+        # recompile, while nothing ever corrects a too-conservative one
+        # (VERDICT r4 Weak #7).
+        margin = 0.0 if abs(hbm_bytes - 16e9) / 16e9 < 0.25 else 0.06
+        if fill <= 0.78 + margin:
             remat = "none"
-        elif fill <= 0.92:
+        elif fill <= 0.92 + margin:
             remat = "dots"
         else:
             remat = "full"

@@ -77,11 +77,28 @@ def measure_decode(include_sliding: bool = False) -> dict:
     t_full = _timed(make_sampler(1 + n_dec, temperature=1.0), model, prompt, key)
     dec_per_tok = max(1e-9, (t_full - t_one) / n_dec)
 
+    # HBM roofline for one decode step (all B tokens): stream every param
+    # once (batched matvecs amortize over B) + stream the live KV slots of
+    # all layers once (scores read K, value-sum reads V — both touched).
+    # Measured rd+wr bandwidth on this chip class ~820 GB/s (PERF.md r5
+    # probe); use 800 as the denominator so the floor is conservative.
+    from midgpt_tpu.models.gpt import count_params
+
+    param_bytes = count_params(model) * 2  # bf16 stream
+    # in-window phase averages W/2 live slots; use the mean over the
+    # measured 256-step window starting at p
+    live_slots = min(p + n_dec / 2, cfg.block_size)
+    kv_bytes = (
+        cfg.n_layer * b * cfg.kv_heads * live_slots * cfg.head_dim * 2 * 2
+    )
+    floor_ms = (param_bytes + kv_bytes) / 800e9 * 1e3
     record = {
         "decode_shape": "124M B=8 T=1024 bf16",
         "decode_prefill_tok_s": round(b * p / t_prefill, 1),
         "decode_tok_s": round(b / dec_per_tok, 1),
         "decode_ms_per_tok": round(dec_per_tok * 1e3, 3),
+        "decode_hbm_floor_ms": round(floor_ms, 3),
+        "decode_vs_floor": round(dec_per_tok * 1e3 / floor_ms, 2),
     }
     if include_sliding:
         # past-window sliding: full-window prompt; per-token rate from the

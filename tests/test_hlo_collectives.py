@@ -254,3 +254,23 @@ def test_multislice_dcn_contract():
     )
 
     _assert_no_batch_gather(colls, mesh)
+
+
+@pytest.mark.slow
+def test_eval_sweep_has_no_batch_allgather():
+    """The r5 eval sweep (make_eval_step: all eval batches through one
+    lax.scan) must shard like the train step — a batch-dim gather inside
+    the scan body would cost eval_batches x the train-step trap."""
+    from midgpt_tpu.train import make_eval_step
+
+    cfg = _shrunk("openwebtext_xl")
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+    sweep = make_eval_step(cfg, mesh)
+    n_eval = 3
+    x = np.zeros((n_eval, BATCH, BLOCK), np.int32)
+    spec = P(None, ("replica", "fsdp"), "sequence")
+    xg = make_global_array(x, mesh, spec)
+    hlo = sweep.lower(state.params, xg, xg).compile().as_text()
+    _assert_no_batch_gather(_collectives(hlo), mesh)
