@@ -420,6 +420,19 @@ def main() -> None:
             lcfg = lstate = lchain = None
             gc.collect()
 
+    # --- auxiliary rung: serving (prefill + KV-cached decode) ------------
+    # skipped when the training rungs already consumed most of the driver
+    # budget (the relay post-mortem in PERF.md: never run into the timeout)
+    if time.perf_counter() - t_start < 300:
+        try:
+            from scripts.bench_decode import measure_decode
+
+            record.update(measure_decode())
+        except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
+            exc.__traceback__ = None
+            record["decode_error"] = repr(exc)[:120]
+            gc.collect()
+
     # --- auxiliary rung: long context (T=4096/8192, 124M family) ---------
     # flash + chunked loss at T >> the kernels' 1024 block cap: exercises
     # the multi-block backward path and the O(T) activation story that
@@ -430,6 +443,9 @@ def main() -> None:
         (4096, 2 * n_dev, "none"),
         (4096, 4 * n_dev, "full"),
     ):
+        if time.perf_counter() - t_start > 420:
+            record.setdefault("long_ctx_error", "skipped: bench budget")
+            break
         try:
             ccfg, cstate, cchain, cmk = _run_config(
                 lc_remat, lc_batch, base="openwebtext",
@@ -460,7 +476,7 @@ def main() -> None:
             ccfg = cstate = cchain = None
             gc.collect()
 
-    if time.perf_counter() - t_start < 240 and "long_ctx_mfu" in record:
+    if time.perf_counter() - t_start < 480 and "long_ctx_mfu" in record:
         try:
             ccfg, cstate, cchain, cmk = _run_config(
                 "none", 1 * n_dev, base="openwebtext",
@@ -486,18 +502,6 @@ def main() -> None:
             ccfg = cstate = cchain = None
             gc.collect()
 
-    # --- auxiliary rung: serving (prefill + KV-cached decode) ------------
-    # skipped when the training rungs already consumed most of the driver
-    # budget (the relay post-mortem in PERF.md: never run into the timeout)
-    if time.perf_counter() - t_start < 300:
-        try:
-            from scripts.bench_decode import measure_decode
-
-            record.update(measure_decode())
-        except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
-            exc.__traceback__ = None
-            record["decode_error"] = repr(exc)[:120]
-            gc.collect()
 
     _all_done.set()  # cancel the mid-run watchdog: main owns the output
     if "value" not in record:

@@ -40,7 +40,16 @@ import jax  # noqa: E402
 # place for the --full on-chip parity run; anything else pins CPU (the
 # historical behavior — JAX_PLATFORMS in the env is ignored on this
 # host, so the pin must happen in-process before backend init)
-_PLATFORM = "tpu" if "--platform=tpu" in sys.argv else "cpu"
+def _sniff_platform() -> str:
+    for i, a in enumerate(sys.argv):
+        if a == "--platform" and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+        if a.startswith("--platform="):
+            return a.split("=", 1)[1]
+    return "cpu"
+
+
+_PLATFORM = _sniff_platform()
 if _PLATFORM == "cpu":
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
@@ -203,6 +212,10 @@ def main() -> None:
                     "pass --steps 5000 for the full run")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    assert args.platform == _PLATFORM, (
+        f"--platform sniffed as {_PLATFORM!r} before backend init but "
+        f"argparse saw {args.platform!r}"
+    )
     if args.full:
         global MODEL, HPARAMS, DROPOUT, OURS_IMPL
         MODEL, HPARAMS = MODEL_FULL, HPARAMS_FULL

@@ -1,20 +1,32 @@
 #!/bin/bash
 # r5 hardware measurement queue: poll the wedged relay; on recovery run
-# every queued measurement in sequence, each detached from timeouts
-# (PERF.md relay rules). Logs under artifacts/r5/.
+# every queued measurement in sequence. Obeys PERF.md relay rules — the
+# probe is DETACHED and never timeout-killed (killing TPU clients
+# mid-RPC is what wedged the relay in r4): one probe hangs harmlessly
+# until the relay recovers, then writes a sentinel the shell polls.
 cd /root/repo
 LOG=artifacts/r5
 mkdir -p "$LOG"
+SENT=/tmp/r5_probe_ok
+rm -f "$SENT"
 
-echo "[queue] $(date -u +%H:%M:%S) polling relay" >> "$LOG/queue.log"
-while true; do
-  if timeout 90 python -c "
+probe() {
+  nohup python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256,256), jnp.bfloat16)
-print(float((x@x)[0,0]))" > /dev/null 2>&1; then
-    break
-  fi
-  sleep 150
+float((x@x)[0,0])
+open('$SENT','w').write('1')" > /dev/null 2>&1 &
+  PROBE_PID=$!
+}
+
+echo "[queue] $(date -u +%H:%M:%S) polling relay (detached probe)" >> "$LOG/queue.log"
+probe
+while true; do
+  sleep 120
+  [ -f "$SENT" ] && break
+  if ! kill -0 "$PROBE_PID" 2>/dev/null; then
+    probe  # previous probe EXITED (clean error) without sentinel: respawn
+  fi     # still running = hanging on the wedge: keep waiting on it
 done
 echo "[queue] $(date -u +%H:%M:%S) relay RECOVERED - starting pipeline" >> "$LOG/queue.log"
 
