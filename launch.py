@@ -54,21 +54,14 @@ def main() -> None:
         "--set", nargs="*", default=[], metavar="KEY=VALUE",
         help="config field overrides, dotted paths allowed",
     )
-    parser.add_argument(
-        "--platform", default=None, choices=("cpu", "tpu"),
-        help="force the JAX backend. On hosts whose site config pins a "
-        "hardware platform, JAX_PLATFORMS in the environment is ignored — "
-        "this flag applies the override in-process, which is the only "
-        "thing that works there (pairs with "
-        "XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU-mesh "
-        "smoke runs)",
-    )
+    from midgpt_tpu.utils.platform_pin import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args()
 
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    apply_platform(args.platform)
 
     if args.multihost:
         jax.distributed.initialize()  # (parity: launch.py:22-23)

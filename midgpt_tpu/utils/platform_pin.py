@@ -1,0 +1,31 @@
+"""In-process JAX backend pinning shared by the CLIs.
+
+On hosts whose site config pins a hardware platform (this container's
+sitecustomize re-pins axon), ``JAX_PLATFORMS`` in the environment is
+IGNORED — the only working override is ``jax.config.update`` in-process,
+before any backend-initializing call. launch.py, sample.py, and
+scripts/check_reference_parity.py all share this helper so the semantics
+can't drift."""
+
+from __future__ import annotations
+
+HELP = (
+    "force the JAX backend in-process (JAX_PLATFORMS in the environment "
+    "is ignored on hosts whose site config pins a platform; pair cpu "
+    "with XLA_FLAGS=--xla_force_host_platform_device_count=N for "
+    "CPU-mesh smoke runs)"
+)
+
+
+def add_platform_arg(parser) -> None:
+    parser.add_argument(
+        "--platform", default=None, choices=("cpu", "tpu"), help=HELP
+    )
+
+
+def apply_platform(platform) -> None:
+    """Pin the backend; must run before any backend-initializing call."""
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -75,9 +75,14 @@ def main() -> None:
         "--chunk_len", type=_positive_int, default=64,
         help="decode chunk length (recent-KV buffer rows; perf knob)",
     )
+    from midgpt_tpu.utils.platform_pin import add_platform_arg, apply_platform
+
+    add_platform_arg(ap)
     args = ap.parse_args()
 
     import jax
+
+    apply_platform(args.platform)
     import jax.numpy as jnp
     import numpy as np
 

@@ -61,3 +61,55 @@ def test_bench_watchdog_fires_on_hung_init():
     assert "NOT_REACHED" not in r.stdout
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["metric"] == "bench_error"
+
+
+def test_rung_measure_falls_back_when_scan_compile_fails():
+    """_rung_measure must fall back to the chained path when the scan
+    program fails to COMPILE (state untouched), and re-raise when the
+    state buffers were already donated (a runtime failure mid-measure
+    would otherwise hand deleted arrays to the fallback)."""
+    sys.path.insert(0, REPO)
+    import types
+
+    import bench
+
+    calls = {"chain": 0}
+
+    class FakeLeaf:
+        def __init__(self, deleted=False):
+            self._deleted = deleted
+
+        def is_deleted(self):
+            return self._deleted
+
+    state = [FakeLeaf()]
+
+    def chain(st, n):
+        calls["chain"] += 1
+        return 0.01 * n, st
+
+    cfg = types.SimpleNamespace(
+        batch_size=8, model=types.SimpleNamespace(block_size=64)
+    )
+
+    def make_scan_compile_fails(n):
+        class M:
+            def lower(self, s):
+                raise RuntimeError("compile boom")
+
+        return M()
+
+    tps, step_ms, st, mode = bench._rung_measure(
+        cfg, state, chain, make_scan_compile_fails
+    )
+    assert mode == "chained" and calls["chain"] >= 2
+
+    # donated state: the fallback must NOT run; original error re-raises
+    dead = [FakeLeaf(deleted=True)]
+    calls["chain"] = 0
+    try:
+        bench._rung_measure(cfg, dead, chain, make_scan_compile_fails)
+        raise AssertionError("expected the compile error to re-raise")
+    except RuntimeError as e:
+        assert "compile boom" in str(e)
+    assert calls["chain"] == 0
