@@ -113,3 +113,59 @@ def test_rung_measure_falls_back_when_scan_compile_fails():
     except RuntimeError as e:
         assert "compile boom" in str(e)
     assert calls["chain"] == 0
+
+
+def test_bench_main_record_flow_with_stubbed_rungs(monkeypatch, capsys):
+    """bench.main() end to end with _run_config stubbed to a trivial CPU
+    closure: every rung family must land its keys in the ONE emitted
+    JSON record (this is the mechanical guard for the record-wiring bug
+    class — r5's code review caught the headline loop rebinding `record`
+    and orphaning the watchdog's dict)."""
+    import types
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    def fake_run_config(remat, batch, base="openwebtext", n_layer=None,
+                        loss_chunk=256, block_size=None):
+        cfg = types.SimpleNamespace(
+            batch_size=batch,
+            model=types.SimpleNamespace(
+                block_size=block_size or 64, remat=remat
+            ),
+        )
+
+        def chain(state, n):
+            return 0.002 * n, state
+
+        def make_scan(n):
+            raise RuntimeError("no scan on the stub")  # force chained
+
+        return cfg, [], chain, make_scan
+
+    monkeypatch.setattr(bench, "_run_config", fake_run_config)
+    monkeypatch.setattr(
+        "midgpt_tpu.utils.metrics.mfu", lambda tps, m, n: 0.5
+    )
+    monkeypatch.setattr(
+        "midgpt_tpu.utils.metrics.flops_per_token", lambda m: 1e9
+    )
+    # decode rung: stub the heavy measure
+    import scripts.bench_decode as bd
+
+    monkeypatch.setattr(
+        bd, "measure_decode", lambda **kw: {"decode_tok_s": 1234.0}
+    )
+
+    bench.main()
+    out = capsys.readouterr().out
+    lines = [l for l in out.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out
+    rec = json.loads(lines[0])
+    # every rung family present in the single record
+    assert rec["metric"].startswith("openwebtext_xl_family")
+    assert "gpt2s_mfu" in rec
+    assert "llama_mfu" in rec
+    assert "decode_tok_s" in rec
+    assert "long_ctx_mfu" in rec
+    assert rec["measure"] == "chained"
