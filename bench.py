@@ -36,7 +36,7 @@ BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
 
 def _run_config(
     remat: str, batch: int, base: str = "openwebtext", n_layer=None,
-    loss_chunk: int = 256, block_size=None,
+    loss_chunk: int = 256, block_size=None, unroll=None,
 ):
     """Build state + step for one candidate config; returns a timing
     closure. Raises on compile/alloc failure (caller falls back)."""
@@ -66,7 +66,8 @@ def _run_config(
         # activations; fully unrolling removed 58 ms/step of 'data
         # formatting' + most loop-fusion overhead (15.2% -> ~40% MFU)
         model=dataclasses.replace(
-            cfg.model, attn_impl="auto", remat=remat, scan_unroll=cfg.model.n_layer
+            cfg.model, attn_impl="auto", remat=remat,
+            scan_unroll=cfg.model.n_layer if unroll is None else unroll,
         ),
         mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=1),
         # head+xent computed T-chunk-wise: the [B,T,V] f32 logits (3.3 GB

@@ -38,6 +38,27 @@ run() {  # run <name> <cmd...>: sequential, logged, never under timeout
 }
 
 run bench1 python bench.py
+run xl_l6_u3 python - << 'PYEOF'
+# ONE cautious attempt to recover the L6-class XL headline: the full-
+# unroll L6/B20 program crashes the remote compile helper (PERF.md r5);
+# unroll=3 halves the program size with most of the unroll win (the DUS
+# stacking cost scales with scan iteration count). If this 500s, do NOT
+# retry — repeated submissions preceded today's wedge.
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+import bench
+from midgpt_tpu.utils.metrics import mfu
+try:
+    cfg, state, chain, mk = bench._run_config(
+        "none", 20, base="openwebtext_xl", n_layer=6, loss_chunk=512, unroll=3)
+    tps, step_ms, state, mode = bench._rung_measure(cfg, state, chain, mk)
+    print({"xl_l6_unroll3_mfu": round(mfu(tps, cfg.model, 1), 4),
+           "step_ms": round(step_ms, 1), "measure": mode})
+except Exception as e:
+    print("L6/B20 unroll3 FAILED:", repr(e)[:300])
+PYEOF
 run decode python scripts/bench_decode.py
 run dkv2048 env MIDGPT_DKV_CAP=2048 python - << 'PYEOF'
 import sys, time
