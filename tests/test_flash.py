@@ -247,3 +247,62 @@ def test_flash_dropout_through_dispatch():
     assert not np.array_equal(np.asarray(o1), np.asarray(o3))
     plain = attention(q, k, v, impl="flash", dropout_rate=0.0)
     assert not np.array_equal(np.asarray(o1), np.asarray(plain))
+
+
+def test_dropout_offsets_anchor_global_coordinates():
+    """The (row_off, col_off, bh_off, n_head_total) anchors (r5, ring
+    support): a call covering rows [r0, r0+t) x cols [c0, c0+t) of a
+    larger virtual score matrix must drop exactly the corresponding
+    sub-block of the GLOBAL mask — verified against the dense oracle of
+    the full matrix."""
+    b, h, t, c = 1, 2, 128, 16
+    big_t = 256
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), b, h, h, big_t, c)
+    seed = jnp.int32(31337)
+    rate = 0.3
+
+    # global oracle over the full [big_t, big_t] coordinate space
+    keepm = flash_mod.dropout_mask_reference(seed, b, h, big_t, rate)
+
+    # the (row block 1, col block 0) off-diagonal tile: fully visible
+    r0, c0 = t, 0
+    qs = q[:, :, r0 : r0 + t]
+    ks, vs = k[:, :, c0 : c0 + t], v[:, :, c0 : c0 + t]
+    out, _ = flash_mod.flash_attention_dropout_lse(
+        qs, ks, vs, seed, rate, causal=False,
+        row_off=jnp.int32(r0), col_off=jnp.int32(c0),
+    )
+
+    # dense recomputation of the same tile with the global mask slice
+    import math
+
+    z = jnp.einsum(
+        "bhqc,bhjc->bhqj", qs, ks, preferred_element_type=jnp.float32
+    ) / math.sqrt(c)
+    p = jax.nn.softmax(z, axis=-1)
+    tile = keepm[:, :, r0 : r0 + t, c0 : c0 + t]
+    p = jnp.where(tile, p / (1.0 - rate), 0.0)
+    ref = jnp.einsum("bhqj,bhjc->bhqc", p.astype(vs.dtype), vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_dropout_bh_offset_selects_global_head_stream():
+    """bh_off + n_head_total must reproduce the mask stream of the
+    corresponding global (batch, head) slice — the property batch/head-
+    sharded ring dropout relies on."""
+    b, h, t, c = 2, 4, 128, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), b, h, h, t, c)
+    seed = jnp.int32(-777)
+    rate = 0.25
+
+    full = flash_mod.flash_attention_dropout(q, k, v, seed, rate, True)
+    # shard: second batch row, heads [2, 4) — its flat bh base is
+    # (1*H + 2) with the GLOBAL head count as stride
+    qs, ks, vs = (a[1:2, 2:4] for a in (q, k, v))
+    shard, _ = flash_mod.flash_attention_dropout_lse(
+        qs, ks, vs, seed, rate, True,
+        bh_off=jnp.int32(1 * h + 2), n_head_total=h,
+    )
+    np.testing.assert_allclose(
+        np.asarray(shard), np.asarray(full[1:2, 2:4]), atol=3e-5
+    )
