@@ -33,7 +33,12 @@ class ModelConfig:
     n_embd: int
     dropout: float = 0.0
     n_kv_head: tp.Optional[int] = None  # None => MHA (= n_head); < n_head => GQA
-    mlp: str = "gelu"  # "gelu" (GPT-2 style, 4x) | "swiglu" (Llama style)
+    mlp: str = "gelu"  # "gelu" (GPT-2, 4x) | "swiglu" (Llama) | "moe"
+    # (Switch-style top-1 mixture of GELU experts; expert-parallel over
+    # the 'tensor' mesh axis — see models/gpt.MoEMLP)
+    moe_experts: int = 8  # experts per MoE layer (mlp="moe")
+    moe_capacity: float = 1.25  # per-row capacity factor: C = cf * T / E
+    moe_aux_weight: float = 0.01  # load-balance aux loss weight (train)
     mlp_ratio: float = 4.0  # hidden = ratio * n_embd (swiglu: per-branch width)
     # exact hidden width; None = ratio * n_embd, with FRACTIONAL products
     # rounded up to a multiple of 256 (Llama's multiple_of rule; also the

@@ -121,6 +121,23 @@ def llama_7b() -> ExperimentConfig:
     )
 
 
+@register("openwebtext_moe")
+def openwebtext_moe() -> ExperimentConfig:
+    """124M-dense-equivalent Switch MoE: 8 experts per MLP (~530M params,
+    ~124M active per token). Beyond the reference (dense-only MLPs);
+    expert-parallel over the 'tensor' mesh axis."""
+    import dataclasses
+
+    base = openwebtext()
+    return dataclasses.replace(
+        base,
+        model=dataclasses.replace(
+            base.model, mlp="moe", moe_experts=8, moe_capacity=1.25,
+        ),
+        mesh=MeshConfig(replica=1, fsdp=-1, sequence=1, tensor=4),
+    )
+
+
 @register("tiny")
 def tiny() -> ExperimentConfig:
     """Minutes-scale config for tests and smoke runs."""

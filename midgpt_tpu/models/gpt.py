@@ -550,11 +550,134 @@ class MLP:
 
 
 @module
+class MoEMLP:
+    """Switch-style top-1 mixture of GELU experts (Switch Transformer,
+    arXiv:2101.03961) — the expert-parallel (ep) MLP variant. Absent from
+    the reference (its MLP is dense, model.py:17-31); built TPU-first:
+
+    - routing/dispatch as DENSE one-hot einsums with STATIC shapes — the
+      canonical TPU MoE formulation (no sorts, no ragged gathers, every
+      FLOP on the MXU); capacity is per batch row: C = ceil(cf * T / E).
+    - experts stacked [E, D, F]/[E, F, D] and sharded over the 'tensor'
+      mesh axis (GPT_PARAM_RULES): each shard computes its local experts'
+      [B, E/tp, C, *] blocks and GSPMD inserts the psum on the combine
+      contraction — expert parallelism without any hand-written
+      collective.
+    - the load-balance auxiliary loss (E * sum_e f_e * p_e; 1.0 when
+      perfectly balanced) is returned next to the output and threaded to
+      the trainer through the layer scan (GPT.hidden(return_aux=True)).
+
+    Tokens overflowing an expert's capacity are dropped (contribute zero;
+    the residual connection passes them through) — standard Switch
+    semantics. Router runs in f32 for a stable softmax."""
+
+    router: Linear  # [D, E]
+    expert_up: Array  # [E, D, F]
+    expert_down: Array  # [E, F, D]
+    capacity_factor: float = static(default=1.25)
+    dropout_rate: float = static(default=0.0)
+
+    @staticmethod
+    def init(key: KeyArray, cfg: ModelConfig) -> "MoEMLP":
+        kr, ku, kd = jax.random.split(key, 3)
+        e, d, f = cfg.moe_experts, cfg.n_embd, mlp_hidden_dim(cfg)
+        # per-expert init identical to Linear.init (truncated normal,
+        # lecun scaling) so experts start like the dense MLP they replace
+        up = (1.0 / jnp.sqrt(d)) * jax.random.truncated_normal(
+            ku, lower=-2, upper=2, shape=(e, d, f), dtype=jnp.float32
+        )
+        down = (1.0 / jnp.sqrt(f)) * jax.random.truncated_normal(
+            kd, lower=-2, upper=2, shape=(e, f, d), dtype=jnp.float32
+        )
+        return MoEMLP(
+            router=Linear.init(kr, d, e),
+            expert_up=up.astype(jnp.float32),
+            expert_down=down.astype(jnp.float32),
+            capacity_factor=cfg.moe_capacity,
+            dropout_rate=cfg.dropout,
+        )
+
+    @property
+    def n_experts(self) -> int:
+        return self.expert_up.shape[0]
+
+    def __call__(
+        self,
+        x: Array,  # [B, T, D]
+        *,
+        key: tp.Optional[KeyArray] = None,
+        deterministic: bool = True,
+    ) -> tp.Tuple[Array, Array]:
+        b, t, d = x.shape
+        e = self.n_experts
+        cap = int(-(-self.capacity_factor * t // e))  # ceil, static
+        cap = max(1, min(cap, t))
+        with jax.named_scope("moe"):
+            # f32 router (tiny [D, E] matmul; softmax stability)
+            logits = self.router(x.astype(jnp.float32))  # [B, T, E]
+            probs = jax.nn.softmax(logits, axis=-1)
+            gate = jnp.max(probs, axis=-1)  # [B, T]
+            idx = jnp.argmax(probs, axis=-1)  # [B, T] top-1 expert
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [B, T, E]
+
+            # load-balance aux (Switch eq. 4): fraction routed vs mean prob
+            frac = jnp.mean(onehot, axis=1)  # [B, E]
+            pmean = jnp.mean(probs, axis=1)  # [B, E]
+            aux = e * jnp.mean(jnp.sum(frac * pmean, axis=-1))
+
+            # position of each token within its expert's capacity buffer
+            pos = jnp.cumsum(onehot, axis=1) * onehot  # [B, T, E], 1-based
+            within = pos <= cap
+            slot = jnp.clip(
+                jnp.sum(pos, axis=-1).astype(jnp.int32) - 1, 0, cap - 1
+            )  # [B, T]
+            keep = (onehot * within).astype(x.dtype)  # [B, T, E]
+            slot_oh = jax.nn.one_hot(slot, cap, dtype=x.dtype)  # [B, T, C]
+
+            # dispatch [B,T,E]x[B,T,C] -> [B,E,C,D] (one-hot einsums: all
+            # static shapes, all MXU)
+            disp = jnp.einsum("bte,btc->btec", keep, slot_oh)
+            xe = jnp.einsum("btec,btd->becd", disp, x)
+            xe = shard_act(xe, "batch", "expert", None, "embed")
+            h = jax.nn.gelu(
+                jnp.einsum(
+                    "becd,edf->becf", xe, self.expert_up.astype(x.dtype)
+                )
+            )
+            # NOT "mlp" on the last dim: it aliases 'tensor', which the
+            # expert dim already occupies
+            h = shard_act(h, "batch", "expert", None, None)
+            ye = jnp.einsum(
+                "becf,efd->becd", h, self.expert_down.astype(x.dtype)
+            )
+            # combine scaled by the router prob (gradient path to router)
+            comb = disp * gate.astype(x.dtype)[:, :, None, None]
+            y = jnp.einsum("btec,becd->btd", comb, ye)
+            y = dropout(y, self.dropout_rate, key, deterministic)
+            return shard_act(y, "batch", "seq", "embed"), aux
+
+
+def make_mlp(key: KeyArray, cfg: ModelConfig):
+    """MLP factory: dense (gelu/swiglu) or MoE by cfg.mlp."""
+    if cfg.mlp == "moe":
+        return MoEMLP.init(key, cfg)
+    return MLP.init(key, cfg)
+
+
+def mlp_call(mlp, x, *, key=None, deterministic=True):
+    """(y, aux) for either MLP kind — dense returns aux = 0."""
+    out = mlp(x, key=key, deterministic=deterministic)
+    if isinstance(mlp, MoEMLP):
+        return out
+    return out, jnp.zeros((), jnp.float32)
+
+
+@module
 class Block:
     """Pre-norm residual block (parity: model.py:84-105)."""
 
     attn: Attention
-    mlp: MLP
+    mlp: tp.Union[MLP, "MoEMLP"]
     ln1: RMSNorm
     ln2: RMSNorm
 
@@ -563,7 +686,7 @@ class Block:
         k1, k2 = jax.random.split(key)
         return Block(
             attn=Attention.init(k1, cfg),
-            mlp=MLP.init(k2, cfg),
+            mlp=make_mlp(k2, cfg),
             # weightless block norms (model.py:94-95, layers.py:64-68)
             ln1=RMSNorm.init(cfg.n_embd, use_weight=False, impl=cfg.norm_impl),
             ln2=RMSNorm.init(cfg.n_embd, use_weight=False, impl=cfg.norm_impl),
@@ -579,6 +702,7 @@ class Block:
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
         return_kv: bool = False,
+        return_aux: bool = False,
     ) -> tp.Union[Array, tp.Tuple[Array, tp.Tuple[Array, Array]]]:
         attn_key, mlp_key = (
             jax.random.split(key) if key is not None else (None, None)
@@ -591,7 +715,12 @@ class Block:
         if return_kv:
             attn_out, kv = attn_out
         x = x + attn_out
-        x = x + self.mlp(self.ln2(x), key=mlp_key, deterministic=deterministic)
+        y, aux = mlp_call(
+            self.mlp, self.ln2(x), key=mlp_key, deterministic=deterministic
+        )
+        x = x + y
+        if return_aux:
+            return ((x, aux), kv) if return_kv else (x, aux)
         return (x, kv) if return_kv else x
 
     def decode_at(self, x, cache_k, cache_v, layer, slot, mask, sin_row, cos_row):
@@ -599,7 +728,7 @@ class Block:
             self.ln1(x), cache_k, cache_v, layer, slot, mask, sin_row, cos_row
         )
         x = x + attn_out
-        x = x + self.mlp(self.ln2(x))
+        x = x + mlp_call(self.mlp, self.ln2(x))[0]
         return x, cache_k, cache_v
 
     def decode_recent_at(
@@ -611,7 +740,7 @@ class Block:
             mask_big, mask_rec, sin_row, cos_row,
         )
         x = x + attn_out
-        x = x + self.mlp(self.ln2(x))
+        x = x + mlp_call(self.mlp, self.ln2(x))[0]
         return x, rk, rv
 
 
@@ -678,10 +807,14 @@ class GPT:
         deterministic: bool = True,
         attn_impl: tp.Optional[str] = None,
         return_kv: bool = False,
+        return_aux: bool = False,
     ) -> tp.Union[Array, tp.Tuple[Array, tp.Tuple[Array, Array]]]:
         """[B, T, D] final (ln_f-normalized) hidden states; with
         ``return_kv`` also the per-layer post-rope K / raw V stacked
-        [L, B, Hkv, T, C] (collected as scan ys — the prefill path)."""
+        [L, B, Hkv, T, C] (collected as scan ys — the prefill path).
+        ``return_aux`` additionally returns the mean per-layer MoE
+        load-balance loss (0.0 for dense MLPs) — the trainer consumes it
+        when cfg.mlp == "moe" (train.loss_fn)."""
         cfg = self.config
         impl = attn_impl if attn_impl is not None else cfg.attn_impl
         b, t = tokens.shape
@@ -700,6 +833,18 @@ class GPT:
 
             def body(carry, layer):
                 block, k = layer
+                if return_aux:
+                    h_in, aux_in = carry
+                    out = block(
+                        h_in, sin, cos, impl=impl, key=k,
+                        deterministic=deterministic, return_kv=return_kv,
+                        return_aux=True,
+                    )
+                    if return_kv:
+                        (h_out, aux), kv = out
+                        return (h_out, aux_in + aux), kv
+                    h_out, aux = out
+                    return (h_out, aux_in + aux), None
                 out = block(
                     carry, sin, cos, impl=impl, key=k,
                     deterministic=deterministic, return_kv=return_kv,
@@ -724,10 +869,20 @@ class GPT:
                 raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
             unroll = cfg.scan_unroll if cfg.scan_unroll else cfg.n_layer
-            h, kvs = jax.lax.scan(
-                body, h, (self.blocks, scan_keys), unroll=unroll
+            carry0 = (h, jnp.zeros((), jnp.float32)) if return_aux else h
+            carry, kvs = jax.lax.scan(
+                body, carry0, (self.blocks, scan_keys), unroll=unroll
             )
+            if return_aux:
+                # SUM over layers (Switch eq. 4 applies alpha per layer
+                # and sums) — a mean would weaken balancing pressure by
+                # n_layer (code review r5)
+                h, aux = carry
+            else:
+                h = carry
             h = self.ln_f(h)
+            if return_aux:
+                return ((h, kvs), aux) if return_kv else (h, aux)
             return (h, kvs) if return_kv else h
 
     def head_weight(self, dtype) -> Array:
@@ -985,6 +1140,12 @@ GPT_PARAM_RULES: tp.Sequence[tp.Tuple[str, P]] = (
     (r"attn/(q|k)_norm/weight", P()),
     (r"mlp/w_(up|gate)/weight", P("fsdp", "tensor")),
     (r"mlp/w_down/weight", P("tensor", "fsdp")),
+    # MoE (mlp="moe"): experts over 'tensor' (expert parallelism), the
+    # dense dims over fsdp (ZeRO); the tiny [D, E] router replicated.
+    # Right-aligned onto the stacked [L, E, D, F] / [L, E, F, D] leaves.
+    (r"mlp/expert_up", P("tensor", "fsdp", None)),
+    (r"mlp/expert_down", P("tensor", None, "fsdp")),
+    (r"mlp/router/weight", P()),
     (r"ln_f/weight|ln1/weight|ln2/weight", P()),
     # [D, V]: embed over fsdp, vocab over tensor
     (r"lm_head/weight", P("fsdp", "tensor")),

@@ -43,6 +43,7 @@ DEFAULT_LOGICAL_RULES: LogicalRules = {
     "kv_heads": "tensor",
     "head_dim": None,
     "mlp": "tensor",
+    "expert": "tensor",  # MoE expert-parallel axis (models/gpt.MoEMLP)
     "vocab": "tensor",
     "layers": None,
 }

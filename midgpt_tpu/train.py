@@ -97,33 +97,54 @@ def loss_fn(
     pp_mesh=None,
     pp_microbatches: int = 0,
     pp_boundary_dtype: tp.Optional[str] = None,
+    include_moe_aux: bool = True,
 ) -> Array:
     """Batched xent; logits in f32 (parity: train.py:72-77). With
     ``loss_chunk``, the head projection + xent run T-chunk by T-chunk
     (ops/loss.py) so the [B,T,V] f32 logits never materialize — same math,
     ~T/chunk less peak loss memory. With ``pp_mesh``, the block stack runs
     pipelined over the mesh's 'pipeline' axis (parallel.pipeline)."""
+    aux = None
     if pp_mesh is not None:
         from midgpt_tpu.parallel.pipeline import gpt_pipeline_hidden
 
+        assert model.config.mlp != "moe", (
+            "MoE is not supported under pipeline parallelism (v1): the "
+            "aux loss rides the layer scan, which PP replaces"
+        )
         h = gpt_pipeline_hidden(
             model, x, pp_mesh, n_micro=pp_microbatches, key=key,
             deterministic=deterministic, boundary_dtype=pp_boundary_dtype,
+        )
+    elif model.config.mlp == "moe":
+        h, aux = model.hidden(
+            x, key=key, deterministic=deterministic, return_aux=True
         )
     else:
         h = model.hidden(x, key=key, deterministic=deterministic)
     if loss_chunk is not None:
         from midgpt_tpu.ops.loss import chunked_softmax_xent
 
-        return chunked_softmax_xent(
+        xent = chunked_softmax_xent(
             h, model.head_weight(h.dtype), y, chunk_t=loss_chunk,
             unroll=loss_chunk_unroll,
         )
-    from midgpt_tpu.parallel.sharding import shard_act
+    else:
+        from midgpt_tpu.parallel.sharding import shard_act
 
-    logits = h @ model.head_weight(h.dtype)  # [B, T, V]
-    logits = shard_act(logits, "batch", "seq", "vocab").astype(jnp.float32)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        logits = h @ model.head_weight(h.dtype)  # [B, T, V]
+        logits = shard_act(
+            logits, "batch", "seq", "vocab"
+        ).astype(jnp.float32)
+        xent = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+    if aux is not None and include_moe_aux:
+        # the OPTIMIZED loss; eval passes include_moe_aux=False so
+        # reported train/val losses stay pure cross-entropy, comparable
+        # to dense baselines (code review r5)
+        xent = xent + model.config.moe_aux_weight * aux
+    return xent
 
 
 def _effective_loss_chunk(cfg: ExperimentConfig, mesh) -> tp.Optional[int]:
@@ -249,7 +270,7 @@ def make_eval_step(cfg: ExperimentConfig, mesh):
                 loss = loss_fn(
                     params_c, x, y, None, True, loss_chunk,
                     cfg.loss_chunk_unroll, pp_mesh, cfg.mesh.pp_microbatches,
-                    cfg.mesh.pp_boundary_dtype,
+                    cfg.mesh.pp_boundary_dtype, include_moe_aux=False,
                 )
                 return acc + loss, None
 
@@ -327,9 +348,10 @@ def estimate_hbm_fill(cfg: ExperimentConfig, n_devices: int,
     f = (m.n_head + 2 * hkv) * c
     mh = mlp_hidden_dim(m)
     hidden = 2 * mh if m.mlp == "swiglu" else mh
+    mlp_mult = m.moe_experts if m.mlp == "moe" else 1
     per_layer_params = (
         m.n_embd * f + m.n_head * c * m.n_embd
-        + (3 if m.mlp == "swiglu" else 2) * m.n_embd * mh
+        + mlp_mult * (3 if m.mlp == "swiglu" else 2) * m.n_embd * mh
     )
     n_params = m.n_layer * per_layer_params + 2 * m.vocab_size * m.n_embd
     state_bytes = n_params * 12  # f32 params + Adam m,v (donated step)
@@ -564,7 +586,12 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # hashes are accepted on restore so old runs still resume.
         from midgpt_tpu.models.gpt import mlp_hidden_dim
 
-        _impl_knobs = ("attn_impl", "norm_impl", "remat", "scan_unroll")
+        # moe_aux_weight is a pure TRAINING knob (no effect on the
+        # parameter tree) — changing it must not block resume
+        _impl_knobs = (
+            "attn_impl", "norm_impl", "remat", "scan_unroll",
+            "moe_aux_weight",
+        )
         _fp_dict = {
             k: v for k, v in to_dict(cfg.model).items() if k not in _impl_knobs
         }
@@ -575,6 +602,19 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             accepted_fingerprints.add(
                 config_fingerprint({**_fp_dict, "mlp_hidden": legacy_mh})
             )
+        if cfg.model.mlp != "moe":
+            # checkpoints saved before the r5 MoE fields existed hashed a
+            # ModelConfig without them; accept those hashes for DENSE
+            # models (an moe checkpoint can't predate the fields)
+            _pre_moe = {
+                k: v for k, v in _fp_dict.items()
+                if k not in ("moe_experts", "moe_capacity")
+            }
+            accepted_fingerprints.add(config_fingerprint(_pre_moe))
+            for legacy_mh in {None, cfg.model.mlp_hidden}:
+                accepted_fingerprints.add(
+                    config_fingerprint({**_pre_moe, "mlp_hidden": legacy_mh})
+                )
 
         key = jax.random.PRNGKey(cfg.seed)
         state = init_state(cfg, mesh, tx, key)
