@@ -73,4 +73,24 @@ print({"llama_dkv2048_mfu": round(mfu(tps, cfg.model, 1), 4), "step_ms": round(s
 PYEOF
 run parity_full python scripts/check_reference_parity.py --full --steps 5000 --eval_interval 1000 --platform=tpu --tol 0.06
 run profile124 python scripts/profile_step.py --config=openwebtext --outdir=artifacts/r5/prof124 --batch 24 --set 'model.remat="none"' 'model.scan_unroll=12' 'model.attn_impl="auto"' loss_chunk=256 loss_chunk_unroll=true 'mesh.fsdp=1' 'mesh.tensor=1'
+run moe_probe python - << 'PYEOF'
+# opportunistic: 124M-family MoE throughput on one chip (experts
+# unsharded; measures the dense-dispatch overhead vs the dense MLP rung)
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+import dataclasses
+import bench
+from midgpt_tpu.config import get_config
+from midgpt_tpu.utils.metrics import mfu
+import midgpt_tpu.train as T
+try:
+    cfg, state, chain, mk = bench._run_config("none", 16, base="openwebtext_moe")
+    tps, step_ms, state, mode = bench._rung_measure(cfg, state, chain, mk)
+    print({"moe124_8e_tokens_per_sec": round(tps, 1), "step_ms": round(step_ms, 1),
+           "measure": mode})
+except Exception as e:
+    print("moe probe FAILED:", repr(e)[:300])
+PYEOF
 echo "[queue] $(date -u +%H:%M:%S) ALL DONE" >> "$LOG/queue.log"

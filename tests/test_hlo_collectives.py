@@ -274,3 +274,27 @@ def test_eval_sweep_has_no_batch_allgather():
     xg = make_global_array(x, mesh, spec)
     hlo = sweep.lower(state.params, xg, xg).compile().as_text()
     _assert_no_batch_gather(_collectives(hlo), mesh)
+
+
+@pytest.mark.slow
+def test_moe_ep_step_has_no_batch_allgather():
+    """MoE under fsdp x tensor (expert parallelism): the one-hot
+    dispatch/combine einsums must not make GSPMD gather full activations
+    — batch stays sharded; the expert contraction's psum is the only
+    intended cross-'tensor' traffic."""
+    cfg = _shrunk("openwebtext")
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model, mlp="moe", moe_experts=4, attn_impl="naive"
+        ),
+        mesh=dataclasses.replace(
+            cfg.mesh, replica=1, fsdp=2, sequence=1, tensor=4
+        ),
+    )
+    hlo, mesh = _compile_cfg(cfg)
+    colls = _collectives(hlo)
+    _assert_no_batch_gather(colls, mesh)
+    assert any(k == "all-reduce" for k, *_ in colls), (
+        "no all-reduce found — the expert-combine psum is missing"
+    )
