@@ -37,6 +37,8 @@ class ModelConfig:
     # (Switch-style top-1 mixture of GELU experts; expert-parallel over
     # the 'tensor' mesh axis — see models/gpt.MoEMLP)
     moe_experts: int = 8  # experts per MoE layer (mlp="moe")
+    moe_top_k: int = 1  # experts per token: 1 = Switch, 2 = GShard-style
+    # (renormalized top-2 gates; aux loss tracks first choices)
     moe_capacity: float = 1.25  # per-row capacity factor: C = cf * T / E
     moe_aux_weight: float = 0.01  # load-balance aux loss weight (train)
     mlp_ratio: float = 4.0  # hidden = ratio * n_embd (swiglu: per-branch width)

@@ -576,6 +576,7 @@ class MoEMLP:
     expert_down: Array  # [E, F, D]
     capacity_factor: float = static(default=1.25)
     dropout_rate: float = static(default=0.0)
+    top_k: int = static(default=1)  # 1 = Switch, 2 = GShard-style
 
     @staticmethod
     def init(key: KeyArray, cfg: ModelConfig) -> "MoEMLP":
@@ -589,12 +590,14 @@ class MoEMLP:
         down = (1.0 / jnp.sqrt(f)) * jax.random.truncated_normal(
             kd, lower=-2, upper=2, shape=(e, f, d), dtype=jnp.float32
         )
+        assert 1 <= cfg.moe_top_k <= e, cfg.moe_top_k
         return MoEMLP(
             router=Linear.init(kr, d, e),
             expert_up=up.astype(jnp.float32),
             expert_down=down.astype(jnp.float32),
             capacity_factor=cfg.moe_capacity,
             dropout_rate=cfg.dropout,
+            top_k=cfg.moe_top_k,
         )
 
     @property
@@ -610,33 +613,43 @@ class MoEMLP:
     ) -> tp.Tuple[Array, Array]:
         b, t, d = x.shape
         e = self.n_experts
-        cap = int(-(-self.capacity_factor * t // e))  # ceil, static
+        # GShard capacity: K claims per token share the buffers, so C
+        # scales with top_k — at K=2 an unscaled C would drop ~(2-cf)/2
+        # of all claims even under perfect balance (code review r5)
+        cap = int(-(-self.capacity_factor * self.top_k * t // e))  # ceil
         cap = max(1, min(cap, t))
+        k = self.top_k
         with jax.named_scope("moe"):
             # f32 router (tiny [D, E] matmul; softmax stability)
             logits = self.router(x.astype(jnp.float32))  # [B, T, E]
             probs = jax.nn.softmax(logits, axis=-1)
-            gate = jnp.max(probs, axis=-1)  # [B, T]
-            idx = jnp.argmax(probs, axis=-1)  # [B, T] top-1 expert
-            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [B, T, E]
+            topv, topi = jax.lax.top_k(probs, k)  # [B, T, K]
+            # chosen-expert assignment matrix (<= K ones per token) and
+            # per-(token, expert) combine weight: top-1 keeps the raw
+            # Switch prob; K > 1 renormalizes the chosen gates to sum 1
+            # (GShard) so identical experts reproduce the dense MLP
+            choice_oh = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [B,T,K,E]
+            assign = jnp.sum(choice_oh, axis=2)  # [B, T, E] in {0, 1}
+            gates = topv / jnp.sum(topv, axis=-1, keepdims=True) if k > 1 else topv
+            w = jnp.einsum("btke,btk->bte", choice_oh, gates)  # [B, T, E]
 
-            # load-balance aux (Switch eq. 4): fraction routed vs mean prob
-            frac = jnp.mean(onehot, axis=1)  # [B, E]
+            # load-balance aux (Switch eq. 4) over FIRST choices
+            first = choice_oh[:, :, 0]  # [B, T, E]
+            frac = jnp.mean(first, axis=1)  # [B, E]
             pmean = jnp.mean(probs, axis=1)  # [B, E]
             aux = e * jnp.mean(jnp.sum(frac * pmean, axis=-1))
 
-            # position of each token within its expert's capacity buffer
-            pos = jnp.cumsum(onehot, axis=1) * onehot  # [B, T, E], 1-based
-            within = pos <= cap
-            slot = jnp.clip(
-                jnp.sum(pos, axis=-1).astype(jnp.int32) - 1, 0, cap - 1
-            )  # [B, T]
-            keep = (onehot * within).astype(x.dtype)  # [B, T, E]
-            slot_oh = jax.nn.one_hot(slot, cap, dtype=x.dtype)  # [B, T, C]
+            # position of each (token, expert) claim within the expert's
+            # capacity buffer — columns are independent, so one cumsum
+            # covers any K
+            pos = jnp.cumsum(assign, axis=1) * assign  # [B, T, E], 1-based
+            keep = (assign * (pos <= cap)).astype(x.dtype)  # [B, T, E]
+            pos0 = jnp.clip(pos.astype(jnp.int32) - 1, 0, cap - 1)
+            slot_oh = jax.nn.one_hot(pos0, cap, dtype=x.dtype)  # [B,T,E,C]
 
-            # dispatch [B,T,E]x[B,T,C] -> [B,E,C,D] (one-hot einsums: all
-            # static shapes, all MXU)
-            disp = jnp.einsum("bte,btc->btec", keep, slot_oh)
+            # dispatch -> [B,E,C,D] (one-hot einsums: all static shapes,
+            # all MXU)
+            disp = keep[..., None] * slot_oh  # [B, T, E, C]
             xe = jnp.einsum("btec,btd->becd", disp, x)
             xe = shard_act(xe, "batch", "expert", None, "embed")
             h = jax.nn.gelu(
@@ -650,8 +663,8 @@ class MoEMLP:
             ye = jnp.einsum(
                 "becf,efd->becd", h, self.expert_down.astype(x.dtype)
             )
-            # combine scaled by the router prob (gradient path to router)
-            comb = disp * gate.astype(x.dtype)[:, :, None, None]
+            # combine scaled by the per-expert gate (router grad path)
+            comb = disp * w.astype(x.dtype)[..., None]
             y = jnp.einsum("btec,becd->btd", comb, ye)
             y = dropout(y, self.dropout_rate, key, deterministic)
             return shard_act(y, "batch", "seq", "embed"), aux

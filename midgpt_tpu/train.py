@@ -602,18 +602,24 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             accepted_fingerprints.add(
                 config_fingerprint({**_fp_dict, "mlp_hidden": legacy_mh})
             )
+        # forward-compat for fields added to ModelConfig after v1:
+        # checkpoints hashed before a field existed lack it in their
+        # fingerprint. Accept the stripped hash ONLY when the current
+        # value equals the legacy-implicit default (so a run that
+        # actually changes the architecture still fails loudly).
+        _legacy_strips = []
         if cfg.model.mlp != "moe":
-            # checkpoints saved before the r5 MoE fields existed hashed a
-            # ModelConfig without them; accept those hashes for DENSE
-            # models (an moe checkpoint can't predate the fields)
-            _pre_moe = {
-                k: v for k, v in _fp_dict.items()
-                if k not in ("moe_experts", "moe_capacity")
-            }
-            accepted_fingerprints.add(config_fingerprint(_pre_moe))
+            # pre-r5 checkpoints predate every moe field (dense only)
+            _legacy_strips.append(("moe_experts", "moe_capacity", "moe_top_k"))
+        if cfg.model.moe_top_k == 1:
+            # early-r5 checkpoints predate moe_top_k (implicitly 1)
+            _legacy_strips.append(("moe_top_k",))
+        for strip in _legacy_strips:
+            _legacy = {k: v for k, v in _fp_dict.items() if k not in strip}
+            accepted_fingerprints.add(config_fingerprint(_legacy))
             for legacy_mh in {None, cfg.model.mlp_hidden}:
                 accepted_fingerprints.add(
-                    config_fingerprint({**_pre_moe, "mlp_hidden": legacy_mh})
+                    config_fingerprint({**_legacy, "mlp_hidden": legacy_mh})
                 )
 
         key = jax.random.PRNGKey(cfg.seed)

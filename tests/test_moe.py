@@ -249,7 +249,8 @@ def test_dense_config_resumes_from_pre_moe_checkpoint(tmp_path):
     fp = {k: v for k, v in to_dict(cfg.model).items() if k not in impl}
     fp["mlp_hidden"] = mlp_hidden_dim(cfg.model)
     legacy = config_fingerprint(
-        {k: v for k, v in fp.items() if k not in ("moe_experts", "moe_capacity")}
+        {k: v for k, v in fp.items()
+         if k not in ("moe_experts", "moe_capacity", "moe_top_k")}
     )
     assert legacy != config_fingerprint(fp)
     metas = glob.glob(str(tmp_path / "run" / "**" / "meta" / "metadata"),
@@ -263,3 +264,91 @@ def test_dense_config_resumes_from_pre_moe_checkpoint(tmp_path):
     cfg2 = dataclasses.replace(cfg, max_steps=6)
     final = train(cfg2)  # must NOT trip the fingerprint assert
     assert np.isfinite(final["val_loss"])
+
+
+def test_moe_top2_identical_experts_equal_dense_exactly():
+    """K=2 renormalizes the chosen gates to sum 1 (GShard), so identical
+    experts with ample capacity must reproduce the dense MLP EXACTLY —
+    a stronger oracle than top-1's gate-scaled version."""
+    cfg = _cfg(moe_capacity=8.0, moe_top_k=2)
+    moe = MoEMLP.init(jax.random.PRNGKey(0), cfg)
+    up0, down0 = moe.expert_up[0], moe.expert_down[0]
+    moe = dataclasses.replace(
+        moe,
+        expert_up=jnp.broadcast_to(up0, moe.expert_up.shape),
+        expert_down=jnp.broadcast_to(down0, moe.expert_down.shape),
+    )
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 32, 16))
+    y, _ = moe(x)
+    dense = jax.nn.gelu(x @ up0) @ down0
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense), atol=1e-5)
+
+
+def test_moe_top2_balanced_router_aux_is_one():
+    """The K=2 aux loss (first-choice fractions) still normalizes to 1.0
+    under a uniform router — guards the K>1 aux path specifically."""
+    cfg = _cfg(moe_top_k=2)
+    moe = MoEMLP.init(jax.random.PRNGKey(0), cfg)
+    moe = dataclasses.replace(
+        moe,
+        router=dataclasses.replace(
+            moe.router, weight=jnp.zeros_like(moe.router.weight)
+        ),
+    )
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 32, 16))
+    _, aux = moe(x)
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-5)
+
+
+def test_moe_top2_trains_and_balances():
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+
+    cfg = ExperimentConfig(
+        model=_cfg(moe_top_k=2),
+        learning_rate=1e-2, warmup_steps=2, lr_decay_steps=20, max_steps=20,
+        batch_size=8, g_accum_iters=1,
+        mesh=MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1),
+    )
+    mesh = create_mesh(cfg.mesh, devices=jax.devices()[:1])
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tx, mesh)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 64, size=(1, 8, 32), dtype=np.int32)
+    spec = P(None, ("replica", "fsdp"), "sequence")
+    xg = make_global_array(x, mesh, spec)
+    losses = []
+    for i in range(6):
+        state, loss = step(state, xg, xg, jax.random.PRNGKey(i))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_moe_top2_ep_parity(mesh8):
+    """Top-2 under the expert-parallel mesh matches single-device."""
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+
+    def run(mesh_cfg, n_dev):
+        cfg = ExperimentConfig(
+            model=_cfg(moe_top_k=2),
+            learning_rate=1e-3, warmup_steps=2, lr_decay_steps=10,
+            max_steps=10, batch_size=8, g_accum_iters=1, mesh=mesh_cfg,
+        )
+        mesh = create_mesh(cfg.mesh, devices=jax.devices()[:n_dev])
+        tx, _ = make_optimizer(cfg)
+        state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, tx, mesh)
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 64, size=(1, 8, 32), dtype=np.int32)
+        spec = P(None, ("replica", "fsdp"), "sequence")
+        xg = make_global_array(x, mesh, spec)
+        _, loss = step(state, xg, xg, jax.random.PRNGKey(1))
+        return float(loss)
+
+    sharded = run(MeshConfig(replica=1, fsdp=2, sequence=1, tensor=2), 4)
+    plain = run(MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1), 1)
+    np.testing.assert_allclose(sharded, plain, rtol=1.5e-3)
