@@ -54,7 +54,10 @@ class ModelConfig:
     # (model.py:134-138, SURVEY.md 2.3)
     # "fused" = projection-natural QK-LN+RoPE+flash (ops/fused_attn);
     # "auto" prefers it on TPU when shapes allow
-    attn_impl: str = "auto"  # auto | naive | flash | ring | fused
+    # ring = streaming K/V ring over 'sequence'; ulysses = all-to-all
+    # head<->sequence trade (parallel/ulysses.py — exact attention +
+    # exact dropout, needs H % S == 0 and tensor == 1)
+    attn_impl: str = "auto"  # auto | naive | flash | ring | ulysses | fused
     ring_schedule: str = "zigzag"  # zigzag (balanced) | standard; zigzag
     # auto-falls back to standard when T doesn't divide 2*sequence
     norm_impl: str = "auto"  # auto | jnp | fused (Pallas one-pass RMSNorm)

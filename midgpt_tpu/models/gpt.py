@@ -168,7 +168,26 @@ class Attention:
             q = shard_act(q, "batch", "heads", "seq", "head_dim")
             k = shard_act(k, "batch", "kv_heads", "seq", "head_dim")
             v = shard_act(v, "batch", "kv_heads", "seq", "head_dim")
-            if impl == "ring":
+            if impl == "ulysses":
+                from midgpt_tpu.parallel.sharding import current_mesh
+                from midgpt_tpu.parallel.ulysses import ulysses_attention
+
+                mesh = current_mesh()
+                assert mesh is not None, (
+                    "attn_impl='ulysses' requires running inside "
+                    "axis_rules(mesh)"
+                )
+                if self.dropout_rate > 0.0 and not deterministic:
+                    u_seed = jax.random.randint(
+                        adrop_key, (), -(2**31), 2**31 - 1, dtype=jnp.int32
+                    )
+                    out = ulysses_attention(
+                        q, k, v, mesh,
+                        dropout_rate=self.dropout_rate, dropout_seed=u_seed,
+                    )
+                else:
+                    out = ulysses_attention(q, k, v, mesh)
+            elif impl == "ring":
                 from midgpt_tpu.parallel.ring import ring_attention
                 from midgpt_tpu.parallel.sharding import current_mesh
 
@@ -1087,7 +1106,11 @@ def prefill(
     # ring needs a live mesh, and an explicit 'flash' may not divide an
     # arbitrary prompt length — 'auto' keeps the flash fast path for
     # aligned prompts and falls back to naive otherwise
-    impl = "auto" if cfg.attn_impl in ("ring", "flash", "fused") else cfg.attn_impl
+    impl = (
+        "auto"
+        if cfg.attn_impl in ("ring", "ulysses", "flash", "fused")
+        else cfg.attn_impl
+    )
 
     h, (ks, vs) = model.hidden(
         tokens, deterministic=True, attn_impl=impl, return_kv=True

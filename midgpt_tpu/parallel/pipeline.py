@@ -216,9 +216,10 @@ def gpt_pipeline_hidden(
     from midgpt_tpu.parallel.sharding import axis_rules, shard_act
 
     cfg = model.config
-    assert cfg.attn_impl != "ring", (
-        "ring attention inside pipeline stages is unsupported (the sequence "
-        "axis is invisible inside the pipeline's manual region)"
+    assert cfg.attn_impl not in ("ring", "ulysses"), (
+        "sequence-parallel attention (ring/ulysses) inside pipeline stages "
+        "is unsupported (the sequence axis is invisible inside the "
+        "pipeline's manual region)"
     )
     b, t = tokens.shape
     s = mesh.shape[axis]

@@ -441,18 +441,10 @@ def ring_attention(
         # sublane-tile-aligned block available
         use_flash = is_tpu_backend() and chunk % 128 == 0
 
-    # only shard batch/head dims over axes that actually divide them
-    def fit(dim: int, axes: tp.Sequence[str]) -> tp.Tuple[str, ...]:
-        kept: tp.List[str] = []
-        prod = 1
-        for a in axes:
-            if dim % (prod * mesh.shape[a]) == 0:
-                kept.append(a)
-                prod *= mesh.shape[a]
-        return tuple(kept)
+    from midgpt_tpu.parallel.sharding import fit_axes
 
-    b_axes = fit(q.shape[0], batch_axes)
-    h_axes = fit(k.shape[1], (head_axis,) if head_axis else ())
+    b_axes = fit_axes(mesh, q.shape[0], batch_axes)
+    h_axes = fit_axes(mesh, k.shape[1], (head_axis,) if head_axis else ())
     spec = P(b_axes if b_axes else None, h_axes if h_axes else None, axis_name, None)
 
     if schedule == "zigzag":

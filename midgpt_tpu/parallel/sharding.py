@@ -95,6 +95,19 @@ def logical_to_spec(logical_axes: tp.Sequence[tp.Optional[str]],
     return P(*[rules.get(a) if a is not None else None for a in logical_axes])
 
 
+def fit_axes(mesh, dim: int, axes) -> tp.Tuple[str, ...]:
+    """Longest prefix of ``axes`` whose product divides ``dim`` — how the
+    SP wrappers (ring/ulysses) decide which mesh axes actually shard a
+    batch/head dimension."""
+    kept = []
+    prod = 1
+    for a in axes:
+        if dim % (prod * mesh.shape[a]) == 0:
+            kept.append(a)
+            prod *= mesh.shape[a]
+    return tuple(kept)
+
+
 def shard_act(x: Array, *logical_axes: tp.Optional[str]) -> Array:
     """Constrain an activation's sharding by logical axis names.
 

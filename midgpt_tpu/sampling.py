@@ -199,7 +199,7 @@ def generate(
     # single-chip full forward: ring needs a live mesh and an explicit
     # 'flash' may not divide W — same impl fallback prefill uses
     # (models/gpt.py prefill)
-    impl = "auto" if cfg.attn_impl in ("ring", "flash", "fused") else cfg.attn_impl
+    impl = "auto" if cfg.attn_impl in ("ring", "ulysses", "flash", "fused") else cfg.attn_impl
 
     def body2(carry, _):
         logits, window, k = carry
