@@ -641,16 +641,39 @@ class MoEMLP:
         with jax.named_scope("moe"):
             # f32 router (tiny [D, E] matmul; softmax stability)
             logits = self.router(x.astype(jnp.float32))  # [B, T, E]
+            # keep every routing tensor batch/seq-sharded: unconstrained,
+            # GSPMD re-shards the [B,T,E] probs around top_k with a
+            # batch all-gather (caught by the HLO audit)
+            logits = shard_act(logits, "batch", "seq", None)
             probs = jax.nn.softmax(logits, axis=-1)
-            topv, topi = jax.lax.top_k(probs, k)  # [B, T, K]
+            # K iterative argmax extractions instead of lax.top_k: XLA's
+            # TopK lowering under GSPMD replicates the batch dim (a
+            # full-batch all-gather, caught by the HLO audit); max/argmax
+            # reductions partition cleanly, and K is static and tiny
+            vals, idxs = [], []
+            remaining = probs
+            for _ in range(k):
+                vals.append(jnp.max(remaining, axis=-1))
+                ix = jnp.argmax(remaining, axis=-1)
+                idxs.append(ix)
+                remaining = remaining * (
+                    1.0 - jax.nn.one_hot(ix, e, dtype=probs.dtype)
+                )
+            topv = jnp.stack(vals, axis=-1)  # [B, T, K]
+            topi = jnp.stack(idxs, axis=-1)
+            topv = shard_act(topv, "batch", "seq", None)
+            topi = shard_act(topi, "batch", "seq", None)
             # chosen-expert assignment matrix (<= K ones per token) and
             # per-(token, expert) combine weight: top-1 keeps the raw
             # Switch prob; K > 1 renormalizes the chosen gates to sum 1
             # (GShard) so identical experts reproduce the dense MLP
             choice_oh = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [B,T,K,E]
+            choice_oh = shard_act(choice_oh, "batch", "seq", None, None)
             assign = jnp.sum(choice_oh, axis=2)  # [B, T, E] in {0, 1}
+            assign = shard_act(assign, "batch", "seq", None)
             gates = topv / jnp.sum(topv, axis=-1, keepdims=True) if k > 1 else topv
             w = jnp.einsum("btke,btk->bte", choice_oh, gates)  # [B, T, E]
+            w = shard_act(w, "batch", "seq", None)
 
             # load-balance aux (Switch eq. 4) over FIRST choices
             first = choice_oh[:, :, 0]  # [B, T, E]
@@ -662,13 +685,16 @@ class MoEMLP:
             # capacity buffer — columns are independent, so one cumsum
             # covers any K
             pos = jnp.cumsum(assign, axis=1) * assign  # [B, T, E], 1-based
+            pos = shard_act(pos, "batch", "seq", None)
             keep = (assign * (pos <= cap)).astype(x.dtype)  # [B, T, E]
+            keep = shard_act(keep, "batch", "seq", None)
             pos0 = jnp.clip(pos.astype(jnp.int32) - 1, 0, cap - 1)
             slot_oh = jax.nn.one_hot(pos0, cap, dtype=x.dtype)  # [B,T,E,C]
 
             # dispatch -> [B,E,C,D] (one-hot einsums: all static shapes,
             # all MXU)
             disp = keep[..., None] * slot_oh  # [B, T, E, C]
+            disp = shard_act(disp, "batch", "seq", "expert", None)
             xe = jnp.einsum("btec,btd->becd", disp, x)
             xe = shard_act(xe, "batch", "expert", None, "embed")
             h = jax.nn.gelu(
