@@ -504,6 +504,22 @@ def main() -> None:
             gc.collect()
 
 
+    # --- comms audit: static per-step wire traffic of the headline -------
+    # config (midgpt_tpu.analysis). Recompiling the measured step is an
+    # executable-cache hit right after its rung ran; the scalar split
+    # (total / DCN bytes, collective count) rides the BENCH_*.json record
+    # so the trajectory tracks comms alongside MFU.
+    audit_cfg = xcfg if xcfg is not None else cfg
+    if audit_cfg is not None and time.perf_counter() - t_start < 540:
+        try:
+            from midgpt_tpu.analysis.harness import train_step_comms_summary
+
+            record.update(train_step_comms_summary(audit_cfg))
+        except Exception as exc:  # noqa: BLE001 — audit rung is best-effort
+            exc.__traceback__ = None
+            record["comms_error"] = repr(exc)[:120]
+            gc.collect()
+
     _all_done.set()  # cancel the mid-run watchdog: main owns the output
     if "value" not in record:
         raise RuntimeError(f"no bench config ran: {record}")

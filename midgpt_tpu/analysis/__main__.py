@@ -1,0 +1,199 @@
+"""CLI: compile a named config's real train step and audit it.
+
+    python -m midgpt_tpu.analysis --config openwebtext_xl --mesh 8
+    python -m midgpt_tpu.analysis --lint [paths...]
+
+The audit mode compiles the config's donated train step on a CPU virtual
+mesh (``--mesh N`` devices; no TPU needed), evaluates the config's
+sharding-invariant ruleset, and prints one JSON report (rules + comms
+cost). Exit status: 0 = all rules pass, 1 = violations (or unwaived lint
+findings), 2 = usage error.
+
+``--override-logical-rule name=axes`` rewrites one entry of the
+activation logical-rule table before compiling — ``batch=`` (empty =
+unsharded) reproduces the opaque-boundary batch-gather trap, which is
+how the test suite proves the audit fails loudly.
+
+Platform note: env setup must precede the first jax import, which is why
+this module parses args and sets ``JAX_PLATFORMS``/``XLA_FLAGS`` before
+touching the harness; on hosts whose site config pins a platform the
+in-process ``jax.config.update`` fallback (utils.platform_pin) applies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import typing as tp
+
+
+def _parse_override(spec: str) -> tp.Tuple[str, tp.Any]:
+    if "=" not in spec:
+        raise argparse.ArgumentTypeError(
+            f"expected name=axes (axes may be empty or '+'-joined): {spec!r}"
+        )
+    name, axes = spec.split("=", 1)
+    if not axes:
+        return name, None
+    parts = axes.split("+")
+    return name, parts[0] if len(parts) == 1 else tuple(parts)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m midgpt_tpu.analysis",
+        description="static HLO/sharding audit of a config's train step",
+    )
+    p.add_argument("--config", help="named config (midgpt_tpu.get_config)")
+    p.add_argument(
+        "--mesh", type=int, default=8, metavar="N",
+        help="CPU virtual device count to compile on (default 8)",
+    )
+    p.add_argument(
+        "--platform", default="cpu", choices=("cpu", "tpu"),
+        help="backend to compile on (default cpu: no hardware needed)",
+    )
+    p.add_argument(
+        "--no-shrink", action="store_true",
+        help="compile the config at full size instead of audit size",
+    )
+    p.add_argument(
+        "--override-logical-rule", action="append", default=[],
+        type=_parse_override, metavar="NAME=AXES",
+        help="rewrite an activation logical-rule entry before compiling "
+        "(e.g. batch= to inject the batch-gather trap)",
+    )
+    p.add_argument(
+        "--json", metavar="PATH",
+        help="also write the JSON report to PATH",
+    )
+    p.add_argument(
+        "--full", action="store_true",
+        help="include the per-collective listing in the report",
+    )
+    p.add_argument(
+        "--lint", nargs="*", metavar="PATH",
+        help="run the AST TPU-footgun lint instead of the HLO audit "
+        "(default path: the midgpt_tpu package)",
+    )
+    return p
+
+
+def _run_lint(paths: tp.List[str]) -> int:
+    from midgpt_tpu.analysis.pylint_pass import lint_paths, unwaived
+
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    bad = unwaived(findings)
+    n_waived = len(findings) - len(bad)
+    print(
+        f"shardlint: {len(bad)} finding(s), {n_waived} waived",
+        file=sys.stderr,
+    )
+    return 1 if bad else 0
+
+
+def _ensure_devices(platform: str, n: int) -> None:
+    """Pin the backend + device count; must run before jax backend init.
+
+    When jax is already initialized in-process (tests), just verify the
+    existing device pool is big enough for the requested mesh.
+    """
+    already = "jax" in sys.modules
+    if platform == "cpu" and not already:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    from midgpt_tpu.utils.platform_pin import apply_platform
+
+    apply_platform(platform)
+    import jax
+
+    jax.config.update("jax_threefry_partitionable", True)  # train.py parity
+    have = jax.device_count()
+    if have < n:
+        raise SystemExit(
+            f"requested --mesh {n} but only {have} device(s) are visible "
+            "(is jax already initialized with a smaller pool?)"
+        )
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.lint is not None:
+        return _run_lint(list(args.lint))
+    if not args.config:
+        build_parser().print_usage(sys.stderr)
+        print("error: --config (or --lint) is required", file=sys.stderr)
+        return 2
+
+    _ensure_devices(args.platform, args.mesh)
+
+    from midgpt_tpu.analysis.harness import audit_config
+    from midgpt_tpu.config import get_config
+
+    try:
+        cfg = get_config(args.config)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    overrides = dict(args.override_logical_rule) or None
+    if overrides:
+        # validate before compiling so a typo'd axis name is a usage
+        # error (exit 2), not a traceback misread as a rule violation
+        from midgpt_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES
+
+        unknown = set(overrides) - set(DEFAULT_LOGICAL_RULES)
+        if unknown:
+            print(
+                f"error: unknown logical axes {sorted(unknown)} "
+                f"(known: {sorted(DEFAULT_LOGICAL_RULES)})",
+                file=sys.stderr,
+            )
+            return 2
+    analysis, report, cost = audit_config(
+        cfg, shrink=not args.no_shrink, logical_overrides=overrides
+    )
+    if not args.full:
+        cost = {k: v for k, v in cost.items() if k != "collectives"}
+    out = {
+        "config": args.config,
+        "ok": report.ok,
+        "mesh": {
+            "axis_names": list(analysis.mesh.axis_names),
+            "axis_sizes": list(analysis.mesh.axis_sizes),
+            "num_slices": analysis.mesh.num_slices,
+        },
+        "geometry": {
+            "global_batch": analysis.global_batch,
+            "block": analysis.block,
+            "donated_leaves": analysis.donated_leaves,
+            "aliased_buffers": len({e.param_number for e in analysis.aliases}),
+        },
+        "rules": report.to_dict()["rules"],
+        "cost": cost,
+    }
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    if not report.ok:
+        for v in report.violations:
+            print(f"VIOLATION {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

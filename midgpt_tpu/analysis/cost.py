@@ -1,0 +1,77 @@
+"""Per-step communication cost report from a parsed compiled step.
+
+Attributes every collective's estimated wire traffic to the mesh axes it
+crosses and splits the total into ICI (intra-slice) vs DCN (cross-slice)
+bytes — the numbers a comms roofline needs, in the same
+one-JSON-object-with-scalar-fields shape as the ``BENCH_*.json``
+trajectory records so the two can ride the same tooling.
+
+Caveat (stated in the report itself): counts are *static* — a collective
+inside a non-unrolled ``while`` loop (grad-accumulation scan, chunked
+loss) is counted once, not per trip. The shipped audit configs compile
+with ``g_accum_iters=1`` and unrolled chunk loops so the static count is
+the per-step count there.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+from midgpt_tpu.analysis.rules import StepAnalysis
+
+SCHEMA_VERSION = 1
+
+
+def cost_report(a: StepAnalysis) -> tp.Dict[str, tp.Any]:
+    """JSON-ready comms report for one compiled step."""
+    by_kind: tp.Dict[str, tp.Dict[str, int]] = {}
+    by_axis: tp.Dict[str, int] = {}
+    collectives = []
+    total_traffic = 0
+    dcn_traffic = 0
+    for c in a.collectives:
+        axes = a.mesh.collective_axes(c)
+        crosses_dcn = a.mesh.collective_crosses_slice(c)
+        traffic = c.traffic_bytes
+        total_traffic += traffic
+        if crosses_dcn:
+            dcn_traffic += traffic
+        k = by_kind.setdefault(c.kind, {"count": 0, "traffic_bytes": 0})
+        k["count"] += 1
+        k["traffic_bytes"] += traffic
+        axis_key = "+".join(axes) if axes else "none"
+        by_axis[axis_key] = by_axis.get(axis_key, 0) + traffic
+        collectives.append({
+            "kind": c.kind,
+            "result_shapes": [
+                f"{d}[{','.join(map(str, s))}]" for d, s in c.result_shapes
+            ],
+            "bytes": c.result_bytes,
+            "traffic_bytes": traffic,
+            "group_size": c.group_size,
+            "mesh_axes": list(axes),
+            "medium": "dcn" if crosses_dcn else ("ici" if axes else "local"),
+            "dims": list(c.dims),
+            "op_name": c.op_name,
+        })
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "metric": "comms_traffic_bytes_per_step",
+        "value": total_traffic,
+        "unit": "bytes",
+        "ici_bytes": total_traffic - dcn_traffic,
+        "dcn_bytes": dcn_traffic,
+        "collective_count": len(a.collectives),
+        "by_kind": by_kind,
+        "by_axis": by_axis,
+        "mesh": {
+            "axis_names": list(a.mesh.axis_names),
+            "axis_sizes": list(a.mesh.axis_sizes),
+            "num_slices": a.mesh.num_slices,
+        },
+        "note": (
+            "static counts: collectives inside while loops are counted "
+            "once, not per trip"
+        ),
+        "collectives": collectives,
+    }

@@ -1,0 +1,194 @@
+"""Compile-the-real-train-step glue for the analyzers.
+
+This is the only module in ``midgpt_tpu.analysis`` that imports jax: it
+builds the mesh/optimizer/state for a named config, compiles the actual
+``make_train_step`` (optionally shrunk to audit size), and hands the
+post-optimization HLO to the jax-free parser/rules/cost layers.
+
+Shrinking (``shrink_for_audit``) keeps the mesh axes, sharding rules and
+code paths of the full config but cuts layers/vocab/sequence so the audit
+compiles in seconds on the 8-device CPU virtual mesh — the partitioner
+decisions the rules check are per-layer-shape, not per-depth.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import typing as tp
+
+import numpy as np
+
+from midgpt_tpu.analysis import hlo as hlo_mod
+from midgpt_tpu.analysis.rules import Report, StepAnalysis, rules_for_config
+from midgpt_tpu.config import ExperimentConfig, get_config
+
+# the input-batch layout every entry point feeds the step with
+# (train.py batch_spec); logical, resolved against the mesh axis names
+BATCH_SPEC_AXES = (None, ("replica", "fsdp"), "sequence")
+
+
+def shrink_for_audit(
+    cfg: ExperimentConfig,
+    *,
+    n_layer: int = 2,
+    block: int = 256,
+    vocab: int = 1024,
+    batch: int = 8,
+) -> ExperimentConfig:
+    """Audit-sized variant of ``cfg``: same mesh axes, sharding rules and
+    code paths (incl. the chunked-loss path via ``loss_chunk=block//2``),
+    shrunk to compile fast on the CPU virtual mesh."""
+    model = dataclasses.replace(
+        cfg.model,
+        n_layer=n_layer,
+        block_size=block,
+        vocab_size=vocab,
+        remat="none",
+        scan_unroll=1,
+    )
+    return dataclasses.replace(
+        cfg,
+        model=model,
+        batch_size=batch,
+        g_accum_iters=1,
+        loss_chunk=block // 2,  # 2 chunks: keeps the chunked-loss path
+    )
+
+
+@contextlib.contextmanager
+def override_logical_rules(overrides: tp.Optional[tp.Mapping[str, tp.Any]]):
+    """Temporarily rewrite entries of the activation logical-rule table
+    (``parallel.sharding.DEFAULT_LOGICAL_RULES``).
+
+    This is the fault-injection hook: mapping ``batch`` to ``None``
+    reproduces the classic opaque-boundary trap (the partitioner gathers
+    the full batch onto every device), which the ``no-batch-allgather``
+    rule must catch. Also usable for what-if cost reports.
+    """
+    if not overrides:
+        yield
+        return
+    from midgpt_tpu.parallel import sharding
+
+    old = sharding.DEFAULT_LOGICAL_RULES
+    unknown = set(overrides) - set(old)
+    assert not unknown, f"unknown logical axes {sorted(unknown)}"
+    patched = dict(old)
+    patched.update(overrides)
+    sharding.DEFAULT_LOGICAL_RULES = patched  # type: ignore[assignment]
+    try:
+        yield
+    finally:
+        sharding.DEFAULT_LOGICAL_RULES = old  # type: ignore[assignment]
+
+
+def compile_train_step(
+    cfg: ExperimentConfig,
+    logical_overrides: tp.Optional[tp.Mapping[str, tp.Any]] = None,
+):
+    """Compile the real donated train step for ``cfg`` on the current
+    backend's devices. Returns ``(hlo_text, mesh, donated_leaves)``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    with override_logical_rules(logical_overrides):
+        # abstract: sharded ShapeDtypeStructs, not device buffers — the
+        # audit lowers/compiles but never executes, so full-size configs
+        # (bench.py's comms rung) don't pay params + Adam moments in HBM
+        state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0), abstract=True)
+        step = make_train_step(cfg, tx, mesh)
+        b = cfg.microbatch_size
+        t = cfg.model.block_size
+        x = np.zeros((cfg.g_accum_iters, b, t), np.int32)
+        xg = make_global_array(x, mesh, P(*BATCH_SPEC_AXES))
+        hlo = step.lower(
+            state, xg, xg, jax.random.PRNGKey(1)
+        ).compile().as_text()
+    donated_leaves = len(jax.tree.leaves(state))
+    return hlo, mesh, donated_leaves
+
+
+def compile_eval_sweep(cfg: ExperimentConfig, n_eval: int = 3):
+    """Compile the stacked-batch eval sweep (``make_eval_step``) for
+    ``cfg``. Returns ``(hlo_text, mesh)``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_eval_step, make_optimizer
+
+    mesh = create_mesh(cfg.mesh)
+    tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0), abstract=True)
+    sweep = make_eval_step(cfg, mesh)
+    b = cfg.microbatch_size
+    t = cfg.model.block_size
+    x = np.zeros((n_eval, b, t), np.int32)
+    xg = make_global_array(x, mesh, P(*BATCH_SPEC_AXES))
+    hlo = sweep.lower(state.params, xg, xg).compile().as_text()
+    return hlo, mesh
+
+
+def analyze_train_step(
+    cfg: ExperimentConfig,
+    *,
+    shrink: bool = True,
+    logical_overrides: tp.Optional[tp.Mapping[str, tp.Any]] = None,
+) -> StepAnalysis:
+    """Compile ``cfg``'s train step and wrap it in a :class:`StepAnalysis`
+    ready for rules/cost evaluation."""
+    audit_cfg = shrink_for_audit(cfg) if shrink else cfg
+    hlo, mesh, donated = compile_train_step(audit_cfg, logical_overrides)
+    return StepAnalysis.from_text(
+        hlo,
+        hlo_mod.MeshInfo.from_mesh(mesh, num_slices=audit_cfg.mesh.num_slices),
+        global_batch=audit_cfg.microbatch_size,
+        block=audit_cfg.model.block_size,
+        donated_leaves=donated,
+    )
+
+
+def audit_config(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    shrink: bool = True,
+    logical_overrides: tp.Optional[tp.Mapping[str, tp.Any]] = None,
+) -> tp.Tuple[StepAnalysis, Report, tp.Dict[str, tp.Any]]:
+    """One-call audit: compile, evaluate the config's ruleset, build the
+    cost report. Returns ``(analysis, rule_report, cost_report)``."""
+    from midgpt_tpu.analysis.cost import cost_report
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    analysis = analyze_train_step(
+        cfg, shrink=shrink, logical_overrides=logical_overrides
+    )
+    report = rules_for_config(cfg, analysis.mesh).evaluate(analysis)
+    return analysis, report, cost_report(analysis)
+
+
+def train_step_comms_summary(cfg: ExperimentConfig) -> tp.Dict[str, tp.Any]:
+    """Flat scalar comms summary for an already-benchmarked config —
+    bench.py attaches this to its one-JSON-line record. Compiles the
+    step as-is (the executable cache makes this a cache hit right after
+    a bench rung ran the same config)."""
+    analysis = analyze_train_step(cfg, shrink=False)
+    from midgpt_tpu.analysis.cost import cost_report
+
+    rep = cost_report(analysis)
+    return {
+        "comms_traffic_bytes_per_step": rep["value"],
+        "comms_dcn_bytes_per_step": rep["dcn_bytes"],
+        "comms_collective_count": rep["collective_count"],
+    }

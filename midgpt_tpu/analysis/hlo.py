@@ -1,0 +1,376 @@
+"""Structured parser over post-optimization HLO text.
+
+The input is ``step.lower(...).compile().as_text()`` — the partitioner's
+actual output, after GSPMD has inserted every collective. This module
+turns that text into typed records:
+
+- :class:`Collective` — one per all-gather / all-reduce / reduce-scatter /
+  collective-permute / all-to-all instruction: result + operand
+  shapes/dtypes, byte counts, device groups (both ``{{0,1},{2,3}}`` and
+  iota ``[G,S]<=[N...]T(...)`` forms), gather ``dimensions``, and the
+  source op_name/line XLA recorded.
+- :class:`AliasEntry` — the module header's ``input_output_alias`` map,
+  i.e. which parameter buffers the executable actually reuses for
+  outputs. This is the ground truth for "did ``donate_argnums`` stick".
+- :class:`MeshInfo` — a jax-free description of the device mesh (axis
+  names/sizes, HLO device id -> mesh coordinates, slice split) so rules
+  and cost attribution can ask *which mesh axes a collective crosses*
+  without importing jax. Built from a live ``jax.sharding.Mesh`` via
+  :meth:`MeshInfo.from_mesh`, or directly from literals in tests.
+
+Everything here is pure text/array processing — no jax import — so the
+fixture-based unit tests run in milliseconds and the module is usable
+from hosts without an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import typing as tp
+
+import numpy as np
+
+# HLO primitive-type byte widths (shapes look like ``bf16[8,256,1024]``)
+DTYPE_BYTES: tp.Mapping[str, int] = {
+    "pred": 1,
+    "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_COLL_RE = re.compile(
+    r"\b(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\("
+)
+# dtype[dims]  — dims empty for scalars
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{.*?\}\}|\[[^\]]*\]<=\[[^\]]*\](?:T\([^)]*\))?)"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=(\{\{.*?\}\})")
+_DIMS_RE = re.compile(r"dimensions=\{([0-9,]+)\}")
+_CHANNEL_RE = re.compile(r"channel_id=([0-9]+)")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_SRCLINE_RE = re.compile(r"source_line=([0-9]+)")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\(([0-9]+),\s*\{[0-9,\s]*\},\s*(may-alias|must-alias)\)"
+)
+
+
+ShapeT = tp.Tuple[int, ...]
+
+
+def shape_bytes(dtype: str, shape: ShapeT) -> int:
+    """Byte size of one ``dtype[shape]`` buffer (unknown dtypes count 0 so
+    token/opaque types never inflate a report)."""
+    n = int(np.prod(shape)) if shape else 1
+    return n * DTYPE_BYTES.get(dtype, 0)
+
+
+def parse_replica_groups(spec: str) -> tp.List[tp.List[int]]:
+    """``replica_groups``/``source_target_pairs`` -> list of device-id groups.
+
+    Handles both the explicit ``{{0,1},{2,3}}`` form and the iota form
+    ``[G,S]<=[N0,N1,...]`` with an optional ``T(perm)`` transpose suffix.
+    """
+    if spec.startswith("{{"):
+        return [
+            [int(x) for x in g.split(",") if x.strip() != ""]
+            for g in re.findall(r"\{([0-9,\s]+)\}", spec)
+        ]
+    m = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](T\(([0-9,]+)\))?", spec)
+    if not m:
+        raise ValueError(f"unparsed replica_groups {spec!r}")
+    gshape = [int(x) for x in m.group(1).split(",")]
+    rshape = [int(x) for x in m.group(2).split(",")]
+    ids = np.arange(int(np.prod(rshape))).reshape(rshape)
+    if m.group(3):
+        ids = np.transpose(ids, [int(x) for x in m.group(4).split(",")])
+    ids = ids.reshape(gshape)
+    return [list(map(int, row)) for row in ids]
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective instruction from the compiled module."""
+
+    kind: str  # all-gather | all-reduce | ... (``-start`` normalized away)
+    line: str  # the full instruction text, stripped
+    lineno: int  # 1-based line in the HLO text
+    result_shapes: tp.Tuple[tp.Tuple[str, ShapeT], ...]  # (dtype, dims)
+    operand_shapes: tp.Tuple[tp.Tuple[str, ShapeT], ...]
+    groups: tp.Tuple[tp.Tuple[int, ...], ...]  # device-id groups
+    dims: tp.Tuple[int, ...]  # gather/scatter `dimensions={...}`
+    channel_id: tp.Optional[int] = None
+    op_name: str = ""  # jax op_name metadata (trace provenance)
+    source_line: tp.Optional[int] = None
+
+    @property
+    def shapes(self) -> tp.Tuple[ShapeT, ...]:
+        """Result dims only (dtype-less) — what shape-pattern rules match."""
+        return tuple(s for _, s in self.result_shapes)
+
+    @property
+    def result_bytes(self) -> int:
+        return sum(shape_bytes(d, s) for d, s in self.result_shapes)
+
+    @property
+    def operand_bytes(self) -> int:
+        return sum(shape_bytes(d, s) for d, s in self.operand_shapes)
+
+    @property
+    def group_size(self) -> int:
+        return max((len(g) for g in self.groups), default=1)
+
+    @property
+    def traffic_bytes(self) -> int:
+        """Per-device wire-traffic estimate under the standard ring
+        algorithms (the numbers comms-bound roofline models use):
+
+        - all-gather: each device receives (G-1)/G of the result
+        - all-reduce: reduce-scatter + all-gather = 2·(G-1)/G of the buffer
+        - reduce-scatter: sends (G-1)/G of the *input* (≈ (G-1)× output)
+        - collective-permute: the whole buffer moves one hop
+        - all-to-all: (G-1)/G of the buffer is exchanged
+        """
+        g = self.group_size
+        if g <= 1:
+            return 0
+        if self.kind == "all-gather":
+            return self.result_bytes * (g - 1) // g
+        if self.kind == "all-reduce":
+            return 2 * self.result_bytes * (g - 1) // g
+        if self.kind == "reduce-scatter":
+            return self.operand_bytes * (g - 1) // g
+        if self.kind == "collective-permute":
+            return self.result_bytes
+        if self.kind == "all-to-all":
+            return self.result_bytes * (g - 1) // g
+        return self.result_bytes
+
+
+def _split_result_operand(line: str, op_start: int) -> tp.Tuple[str, str]:
+    """Split an instruction line into its result-shape text (between '='
+    and the op keyword) and the operand text (inside the op's parens)."""
+    head = line[:op_start]
+    if " = " in head:
+        head = head.split(" = ", 1)[1]
+    # operand list: from the '(' that opens the op call to its matching ')'
+    lparen = line.index("(", op_start)
+    depth, rparen = 0, len(line)
+    for i in range(lparen, len(line)):
+        if line[i] == "(":
+            depth += 1
+        elif line[i] == ")":
+            depth -= 1
+            if depth == 0:
+                rparen = i
+                break
+    return head, line[lparen + 1 : rparen]
+
+
+def parse_collectives(hlo: str) -> tp.List[Collective]:
+    """Every collective instruction in the module, in textual order."""
+    out: tp.List[Collective] = []
+    for lineno, raw in enumerate(hlo.splitlines(), start=1):
+        m = _COLL_RE.search(raw)
+        if m is None or "=" not in raw:
+            continue
+        line = raw.strip()
+        m = _COLL_RE.search(line)
+        assert m is not None
+        kind = m.group(1)
+
+        gm = _GROUPS_RE.search(line)
+        pm = _PAIRS_RE.search(line)
+        if gm:
+            groups = parse_replica_groups(gm.group(1))
+        elif pm:
+            # each {src,dst} pair is a 2-device "group" for crossing checks
+            groups = parse_replica_groups(pm.group(1))
+        else:
+            groups = []
+
+        head, operands = _split_result_operand(line, m.start())
+        result_shapes = tuple(
+            (d, tuple(int(x) for x in dims.split(",") if x != ""))
+            for d, dims in _SHAPE_RE.findall(head)
+        )
+        operand_shapes = tuple(
+            (d, tuple(int(x) for x in dims.split(",") if x != ""))
+            for d, dims in _SHAPE_RE.findall(operands)
+        )
+
+        dm = _DIMS_RE.search(line)
+        cm = _CHANNEL_RE.search(line)
+        om = _OPNAME_RE.search(line)
+        sm = _SRCLINE_RE.search(line)
+        out.append(
+            Collective(
+                kind=kind,
+                line=line,
+                lineno=lineno,
+                result_shapes=result_shapes,
+                operand_shapes=operand_shapes,
+                groups=tuple(tuple(g) for g in groups),
+                dims=tuple(int(x) for x in dm.group(1).split(",")) if dm else (),
+                channel_id=int(cm.group(1)) if cm else None,
+                op_name=om.group(1) if om else "",
+                source_line=int(sm.group(1)) if sm else None,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Buffer-donation audit
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasEntry:
+    """One entry of the module's ``input_output_alias`` map."""
+
+    output_index: tp.Tuple[int, ...]  # index into the (tuple) result
+    param_number: int  # flat entry-parameter number
+    kind: str  # may-alias | must-alias
+
+
+def parse_input_output_alias(hlo: str) -> tp.List[AliasEntry]:
+    """The executable's input->output buffer aliasing, from the module
+    header. Empty when donation was dropped (or never requested)."""
+    for line in hlo.splitlines():
+        if "input_output_alias=" not in line:
+            continue
+        return [
+            AliasEntry(
+                output_index=tuple(
+                    int(x) for x in e[0].split(",") if x.strip() != ""
+                ),
+                param_number=int(e[1]),
+                kind=e[2],
+            )
+            for e in _ALIAS_ENTRY_RE.findall(line)
+        ]
+    return []
+
+
+def count_entry_parameters(hlo: str) -> int:
+    """Number of flat parameters of the entry computation, from the
+    ``entry_computation_layout={(...)->...}`` header clause."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo)
+    if not m:
+        return 0
+    inner = m.group(1).strip()
+    if not inner:
+        return 0
+    # parameters are comma-separated shapes; commas also appear inside
+    # [dims] and {layout} brackets, so count only depth-0 commas
+    depth = 0
+    count = 1
+    for ch in inner:
+        if ch in "[{(":
+            depth += 1
+        elif ch in "]})":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            count += 1
+    return count
+
+
+def dtypes_used(hlo: str) -> tp.Set[str]:
+    """Every HLO primitive dtype appearing in a shape anywhere in the
+    module (the no-f64 rule scans this)."""
+    return {d for d, _ in _SHAPE_RE.findall(hlo) if d in DTYPE_BYTES}
+
+
+# ---------------------------------------------------------------------------
+# Mesh description (jax-free)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshInfo:
+    """Axis names/sizes + HLO-device-id -> mesh-coordinate mapping.
+
+    HLO collectives name devices by *logical* id — the position in the
+    mesh's device assignment, i.e. the flattened index into
+    ``mesh.devices`` — so coordinates are ``unravel_index(id, shape)``.
+
+    ``num_slices > 1`` marks the leading factor of the ``replica`` axis as
+    the DCN (cross-slice) dimension, matching
+    ``parallel.mesh.hybrid_device_layout``.
+    """
+
+    axis_names: tp.Tuple[str, ...]
+    axis_sizes: tp.Tuple[int, ...]
+    num_slices: int = 1
+
+    @classmethod
+    def from_mesh(cls, mesh, num_slices: int = 1) -> "MeshInfo":
+        return cls(
+            axis_names=tuple(mesh.axis_names),
+            axis_sizes=tuple(mesh.devices.shape),
+            num_slices=num_slices,
+        )
+
+    @property
+    def shape(self) -> tp.Dict[str, int]:
+        return dict(zip(self.axis_names, self.axis_sizes))
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(self.axis_sizes))
+
+    def coords(self, device_id: int) -> tp.Tuple[int, ...]:
+        return tuple(
+            int(c) for c in np.unravel_index(device_id, self.axis_sizes)
+        )
+
+    def crossed_axes(self, group: tp.Sequence[int]) -> tp.Tuple[str, ...]:
+        """Mesh axes along which the group's devices differ — the axes
+        this collective actually moves data across."""
+        if len(group) < 2:
+            return ()
+        coords = np.asarray([self.coords(d) for d in group])
+        return tuple(
+            name
+            for i, name in enumerate(self.axis_names)
+            if len(set(coords[:, i].tolist())) > 1
+        )
+
+    def collective_axes(self, coll: Collective) -> tp.Tuple[str, ...]:
+        axes: tp.List[str] = []
+        for g in coll.groups:
+            for a in self.crossed_axes(g):
+                if a not in axes:
+                    axes.append(a)
+        return tuple(sorted(axes, key=self.axis_names.index))
+
+    def slice_of(self, device_id: int) -> int:
+        """Slice (DCN domain) of a device: the leading ``num_slices``
+        factor of its 'replica' coordinate."""
+        if self.num_slices <= 1:
+            return 0
+        rep_axis = self.axis_names.index("replica")
+        rep = self.coords(device_id)[rep_axis]
+        per_slice = self.axis_sizes[rep_axis] // self.num_slices
+        return rep // per_slice
+
+    def crosses_slice(self, group: tp.Sequence[int]) -> bool:
+        return len({self.slice_of(d) for d in group}) > 1
+
+    def collective_crosses_slice(self, coll: Collective) -> bool:
+        return any(self.crosses_slice(g) for g in coll.groups if g)

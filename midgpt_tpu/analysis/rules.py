@@ -1,0 +1,392 @@
+"""Declarative ruleset engine over a parsed compiled step.
+
+A :class:`StepAnalysis` bundles everything a rule may inspect (HLO text,
+parsed collectives, mesh description, batch geometry, donation audit);
+each :class:`Rule` returns :class:`Violation` records. Rules are pure
+functions of the analysis — no jax, no compilation — so they run against
+canned fixtures in unit tests exactly as they run against a freshly
+compiled train step.
+
+The built-in rules encode the repo's sharding invariants (previously
+300 lines of ad-hoc regex inside tests/test_hlo_collectives.py):
+
+- ``no-batch-allgather`` — the classic GSPMD trap: an opaque boundary
+  makes the partitioner gather the full batch onto every device.
+- ``dcn-allreduce-only`` + ``cross-slice-grad-allreduce`` — the
+  multi-slice DCN contract (SURVEY.md 2.6: DP-only across slices).
+- ``seq-permute-not-gather`` — ring attention must move K/V by
+  collective-permute hops, never by reconstituting the full sequence.
+- ``expect-collective`` — a required collective kind exists (e.g. the
+  MoE expert-combine psum).
+- ``no-f64`` — nothing in the module computes in double precision.
+- ``donation-intact`` — ``donate_argnums`` actually produced
+  input/output buffer aliases (donation silently drops when shapes,
+  layouts, or shardings stop matching).
+
+New parallel configs pick their rules via :func:`rules_for_config`
+(or build a custom list) instead of copy-pasting regexes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+from midgpt_tpu.analysis import hlo as hlo_mod
+from midgpt_tpu.analysis.hlo import AliasEntry, Collective, MeshInfo
+
+# mesh axes a global batch is sharded over / the sequence axis — kept in
+# sync with parallel.mesh (imported lazily there to stay jax-free here)
+BATCH_AXES = ("replica", "fsdp")
+SEQUENCE_AXIS = "sequence"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    message: str
+    line: str = ""  # offending HLO line, when there is one
+
+    def __str__(self) -> str:
+        s = f"[{self.rule}] {self.message}"
+        if self.line:
+            s += f"\n    {self.line}"
+        return s
+
+
+@dataclasses.dataclass(frozen=True)
+class StepAnalysis:
+    """Everything the rules (and cost report) inspect about one compiled
+    step. Build from HLO text + mesh description; the compile harness
+    (:mod:`midgpt_tpu.analysis.harness`) fills this from a live config."""
+
+    hlo: str
+    mesh: MeshInfo
+    collectives: tp.Tuple[Collective, ...]
+    global_batch: tp.Optional[int] = None  # per-microstep sequences (B)
+    block: tp.Optional[int] = None  # sequence length (T)
+    aliases: tp.Tuple[AliasEntry, ...] = ()
+    donated_leaves: tp.Optional[int] = None  # expected aliased buffers
+
+    @classmethod
+    def from_text(
+        cls,
+        hlo: str,
+        mesh: MeshInfo,
+        global_batch: tp.Optional[int] = None,
+        block: tp.Optional[int] = None,
+        donated_leaves: tp.Optional[int] = None,
+    ) -> "StepAnalysis":
+        return cls(
+            hlo=hlo,
+            mesh=mesh,
+            collectives=tuple(hlo_mod.parse_collectives(hlo)),
+            global_batch=global_batch,
+            block=block,
+            aliases=tuple(hlo_mod.parse_input_output_alias(hlo)),
+            donated_leaves=donated_leaves,
+        )
+
+    @property
+    def local_batch(self) -> tp.Optional[int]:
+        """Per-device batch: B over the data-parallel axes."""
+        if self.global_batch is None:
+            return None
+        shape = self.mesh.shape
+        div = 1
+        for a in BATCH_AXES:
+            div *= shape.get(a, 1)
+        return max(1, self.global_batch // div)
+
+    @property
+    def local_t(self) -> tp.Optional[int]:
+        if self.block is None:
+            return None
+        return self.block // self.mesh.shape.get(SEQUENCE_AXIS, 1)
+
+
+class Rule:
+    """Base rule: subclasses set ``name``/``description`` and implement
+    :meth:`check` returning a list of violations (empty = pass)."""
+
+    name: str = "rule"
+    description: str = ""
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def violation(self, message: str, line: str = "") -> Violation:
+        return Violation(rule=self.name, message=message, line=line)
+
+
+_FLOAT_DTYPES = frozenset(
+    {"f8e4m3fn", "f8e5m2", "f8e4m3b11fnuz", "f16", "bf16", "f32", "f64"}
+)
+
+
+class NoBatchAllGather(Rule):
+    """No all-gather over dim 0 of a ``[B_local, T_local, ...]``
+    floating-point activation. Rank-2 gathers are FSDP param shards
+    (legitimate); feature-dim gathers are TP traffic (legitimate);
+    integer gathers are index plumbing — e.g. the ``[B, T, 1]`` s32
+    token-id gather an embed-dim-sharded embedding take needs — tiny
+    and intended, not the trap."""
+
+    name = "no-batch-allgather"
+    description = "no batch-dim all-gather of activations"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        assert a.global_batch is not None and a.block is not None, (
+            f"{self.name} needs batch/block geometry on the StepAnalysis"
+        )
+        b_local, t_local = a.local_batch, a.local_t
+        out = []
+        for c in a.collectives:
+            if c.kind != "all-gather":
+                continue
+            for dtype, shape in c.result_shapes:
+                if dtype not in _FLOAT_DTYPES:
+                    continue
+                # activations are rank>=3 [B, T, ...]; the sequence dim
+                # carries T_local on sequence-sharded meshes
+                if (
+                    len(shape) >= 3
+                    and 0 in c.dims
+                    and shape[1] in (t_local, a.block)
+                    and shape[0] >= b_local
+                ):
+                    out.append(self.violation(
+                        "batch-dim all-gather of an activation "
+                        f"{shape} (op {c.op_name or '?'})",
+                        c.line,
+                    ))
+        return out
+
+
+class NoFullSequenceGather(Rule):
+    """No rank>=3 activation all-gather that reconstitutes the full
+    sequence length T on any dim >= 1 — the anti-pattern ring attention
+    exists to avoid (K/V sit at [B,H,T,C] with T at dim 2)."""
+
+    name = "seq-permute-not-gather"
+    description = "sequence moves by permute hops, not full-T gathers"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        assert a.block is not None, f"{self.name} needs block geometry"
+        out = []
+        for c in a.collectives:
+            if c.kind != "all-gather":
+                continue
+            for shape in c.shapes:
+                if len(shape) >= 3 and any(
+                    d >= 1 and d < len(shape) and shape[d] == a.block
+                    for d in c.dims
+                ):
+                    out.append(self.violation(
+                        f"full-sequence all-gather of an activation {shape}",
+                        c.line,
+                    ))
+        return out
+
+
+class ExpectCollective(Rule):
+    """A collective of ``kind`` must EXIST (e.g. the ring's permute hops,
+    the MoE expert-combine psum) — its absence means the schedule the
+    config paid for is not in the compiled step."""
+
+    name = "expect-collective"
+    description = "a required collective kind is present"
+
+    def __init__(self, kind: str, why: str = ""):
+        self.kind = kind
+        self.why = why
+        self.name = f"expect-{kind}"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        if any(c.kind == self.kind for c in a.collectives):
+            return []
+        msg = f"no {self.kind} found in the compiled step"
+        if self.why:
+            msg += f" — {self.why}"
+        return [self.violation(msg)]
+
+
+class DcnAllReduceOnly(Rule):
+    """Multislice DCN contract: every collective whose device group
+    crosses the slice boundary must be an all-reduce (gradient/loss sums)
+    with no activation-shaped operand — FSDP/TP gathers and permutes must
+    stay inside a slice (SURVEY.md 2.6)."""
+
+    name = "dcn-allreduce-only"
+    description = "cross-slice traffic is all-reduce-only, no activations"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        assert a.mesh.num_slices > 1, f"{self.name} needs a multislice mesh"
+        b_local = a.local_batch
+        out = []
+        for c in a.collectives:
+            if not a.mesh.collective_crosses_slice(c):
+                continue
+            if c.kind != "all-reduce":
+                out.append(self.violation(
+                    f"{c.kind} crosses the slice boundary (DCN)", c.line
+                ))
+                continue
+            if b_local is not None and a.block is not None:
+                for shape in c.shapes:
+                    if len(shape) >= 2 and shape[:2] == (b_local, a.block):
+                        out.append(self.violation(
+                            "activation-shaped all-reduce crosses slices",
+                            c.line,
+                        ))
+        return out
+
+
+class CrossSliceGradAllReduce(Rule):
+    """The cross-slice gradient all-reduce must EXIST: a step with no
+    replica sync at all would silently train divergent replicas."""
+
+    name = "cross-slice-grad-allreduce"
+    description = "a param-shaped all-reduce crosses the slice boundary"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        assert a.mesh.num_slices > 1, f"{self.name} needs a multislice mesh"
+        for c in a.collectives:
+            if c.kind != "all-reduce":
+                continue
+            if not a.mesh.collective_crosses_slice(c):
+                continue
+            if any(len(s) >= 2 for s in c.shapes):  # param-shaped sync
+                return []
+        return [self.violation(
+            "no cross-slice gradient all-reduce found — replicas would "
+            "train divergently (DP sync missing from the compiled step)"
+        )]
+
+
+class NoF64(Rule):
+    """No f64/c128 anywhere in the module: TPUs emulate double precision
+    at a catastrophic slowdown, so any f64 means an accidental promotion
+    (a Python float, np default dtype, ...) leaked into the step."""
+
+    name = "no-f64"
+    description = "no double-precision buffers in the compiled step"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        bad = hlo_mod.dtypes_used(a.hlo) & {"f64", "c128"}
+        if not bad:
+            return []
+        return [self.violation(
+            f"double-precision dtypes in the compiled step: {sorted(bad)}"
+        )]
+
+
+class DonationIntact(Rule):
+    """``donate_argnums`` actually stuck: the executable aliases at least
+    ``donated_leaves`` parameter buffers to outputs. XLA silently drops
+    donation when an output's shape/layout/sharding stops matching its
+    donated input — at 1.5B params that silently doubles state HBM."""
+
+    name = "donation-intact"
+    description = "donated state buffers are aliased input->output"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        expected = a.donated_leaves
+        assert expected is not None and expected > 0, (
+            f"{self.name} needs donated_leaves on the StepAnalysis"
+        )
+        aliased = {e.param_number for e in a.aliases}
+        if len(aliased) >= expected:
+            return []
+        return [self.violation(
+            f"only {len(aliased)} of {expected} donated state buffers are "
+            "aliased input->output — donation was (partially) dropped and "
+            "the step holds two copies of the un-aliased state"
+        )]
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleResult:
+    rule: str
+    description: str
+    violations: tp.Tuple[Violation, ...]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+@dataclasses.dataclass(frozen=True)
+class Report:
+    results: tp.Tuple[RuleResult, ...]
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    @property
+    def violations(self) -> tp.Tuple[Violation, ...]:
+        return tuple(v for r in self.results for v in r.violations)
+
+    def to_dict(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "ok": self.ok,
+            "rules": [
+                {
+                    "rule": r.rule,
+                    "ok": r.ok,
+                    "description": r.description,
+                    "violations": [
+                        {"message": v.message, "line": v.line}
+                        for v in r.violations
+                    ],
+                }
+                for r in self.results
+            ],
+        }
+
+
+class RuleSet:
+    def __init__(self, rules: tp.Iterable[Rule]):
+        self.rules = list(rules)
+
+    def evaluate(self, analysis: StepAnalysis) -> Report:
+        return Report(results=tuple(
+            RuleResult(
+                rule=r.name,
+                description=r.description,
+                violations=tuple(r.check(analysis)),
+            )
+            for r in self.rules
+        ))
+
+
+def rules_for_config(cfg, mesh: MeshInfo) -> RuleSet:
+    """The invariants a shipped config must satisfy, derived from its
+    declared parallelism. New parallel configs extend this mapping (or
+    pass a hand-built RuleSet to the CLI/tests) instead of writing HLO
+    regexes.
+
+    ``cfg`` is an :class:`midgpt_tpu.config.ExperimentConfig`; only its
+    declarative fields are read, so this stays jax-free.
+    """
+    rules: tp.List[Rule] = [
+        NoF64(),
+        NoBatchAllGather(),
+        DonationIntact(),
+    ]
+    shape = mesh.shape
+    if cfg.model.attn_impl == "ring" and shape.get(SEQUENCE_AXIS, 1) > 1:
+        rules.append(NoFullSequenceGather())
+        rules.append(ExpectCollective(
+            "collective-permute",
+            "the ring schedule is not in the compiled step",
+        ))
+    if cfg.model.mlp == "moe" and shape.get("tensor", 1) > 1:
+        rules.append(ExpectCollective(
+            "all-reduce", "the expert-combine psum is missing"
+        ))
+    if mesh.num_slices > 1:
+        rules.append(DcnAllReduceOnly())
+        rules.append(CrossSliceGradAllReduce())
+    return RuleSet(rules)

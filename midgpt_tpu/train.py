@@ -229,6 +229,14 @@ def make_train_step(
         # average + promote to param dtype for the f32 optimizer update
         grads = jax.tree.map(lambda gr: (gr / g).astype(param_dtype), grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        # constrain the NEW opt state like params (the Adam moments are
+        # param-shaped subtrees, so the same rule table resolves them;
+        # re.search matches the param path inside the opt-state path).
+        # Without this, GSPMD may give the output moments a different
+        # sharding than the input ones and jit silently DROPS their
+        # donation — the step then holds two copies of m/v in HBM
+        # (found by the analysis subsystem's donation-intact rule).
+        new_opt = constrain_params(new_opt, mesh, param_rules)
         new_params = optax.apply_updates(state.params, updates)
         new_params = constrain_params(new_params, mesh, param_rules)
         return (
@@ -283,17 +291,26 @@ def make_eval_step(cfg: ExperimentConfig, mesh):
 
 
 def init_state(
-    cfg: ExperimentConfig, mesh, tx, key: Array, param_rules=None
+    cfg: ExperimentConfig, mesh, tx, key: Array, param_rules=None,
+    abstract: bool = False,
 ) -> TrainState:
     """Init under jit with sharding constraints so params materialize
-    directly sharded (parity: train.py:163-177)."""
+    directly sharded (parity: train.py:163-177).
+
+    ``abstract=True`` returns the same pytree as sharding-annotated
+    ``ShapeDtypeStruct``s without allocating any device buffers (the init
+    program is compiled, never executed) — enough to ``.lower()`` the
+    train step for the HLO audit without paying full-size params + Adam
+    moments in HBM."""
     if param_rules is None:
         param_rules = _cfg_param_rules(cfg)
 
     def init_fn(k):
         model = GPT.init(k, cfg.model)
         model = constrain_params(model, mesh, param_rules)
-        opt_state = tx.init(model)
+        # same explicit shardings the train step constrains the updated
+        # opt state to — donation requires input/output shardings to match
+        opt_state = constrain_params(tx.init(model), mesh, param_rules)
         return TrainState(
             params=model, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
         )
@@ -301,6 +318,13 @@ def init_state(
     from contextlib import nullcontext
 
     with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") else nullcontext():
+        if abstract:
+            shardings = jax.jit(init_fn).lower(key).compile().output_shardings
+            shapes = jax.eval_shape(init_fn, key)
+            return jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                shapes, shardings,
+            )
         return jax.jit(init_fn)(key)
 
 

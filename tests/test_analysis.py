@@ -1,0 +1,443 @@
+"""Unit tests for midgpt_tpu.analysis: HLO parser, ruleset engine, cost
+report (millisecond fixture-based tests — no compilation), plus a small
+set of compile-backed regression tests:
+
+- the donated train step is FULLY aliased input->output (catches a
+  silently-dropped ``donate_argnums=(0,)`` — and the partial drop of the
+  Adam-moment donation this subsystem found in train.py);
+- injecting a bad PartitionSpec (batch logical axis unsharded) makes the
+  CLI exit non-zero with a no-batch-allgather violation.
+
+Fixtures under tests/fixtures/ are hand-written post-optimization HLO in
+the exact textual forms XLA emits (explicit + iota replica_groups,
+input_output_alias header, operand shapes inline).
+"""
+
+import dataclasses
+import json
+import pathlib
+
+import pytest
+
+from midgpt_tpu.analysis import (
+    MeshInfo,
+    StepAnalysis,
+    cost_report,
+    count_entry_parameters,
+    dtypes_used,
+    parse_collectives,
+    parse_input_output_alias,
+    parse_replica_groups,
+    rules_for_config,
+)
+from midgpt_tpu.analysis.rules import (
+    CrossSliceGradAllReduce,
+    DcnAllReduceOnly,
+    DonationIntact,
+    ExpectCollective,
+    NoBatchAllGather,
+    NoF64,
+    NoFullSequenceGather,
+)
+from midgpt_tpu.config import MeshConfig, get_config
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+# the fixtures' mesh: 8 devices as (pipeline, replica, fsdp, seq, tensor)
+MESH = MeshInfo(
+    axis_names=("pipeline", "replica", "fsdp", "sequence", "tensor"),
+    axis_sizes=(1, 2, 2, 1, 2),
+)
+MESH_2SLICE = dataclasses.replace(MESH, num_slices=2)
+
+# fixture geometry: global batch 8 over replica*fsdp=4 -> b_local 2; T=256
+B, T = 8, 256
+
+
+def _fixture(name: str) -> str:
+    return (FIXTURES / name).read_text()
+
+
+def _analysis(name: str, mesh=MESH, donated=None) -> StepAnalysis:
+    return StepAnalysis.from_text(
+        _fixture(name), mesh, global_batch=B, block=T, donated_leaves=donated
+    )
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def test_parse_replica_groups_explicit():
+    assert parse_replica_groups("{{0,2},{1,3}}") == [[0, 2], [1, 3]]
+
+
+def test_parse_replica_groups_iota():
+    assert parse_replica_groups("[2,4]<=[8]") == [
+        [0, 1, 2, 3], [4, 5, 6, 7],
+    ]
+
+
+def test_parse_replica_groups_iota_transpose():
+    # [4,2]<=[2,4]T(1,0): arange(8).reshape(2,4).T.reshape(4,2)
+    assert parse_replica_groups("[4,2]<=[2,4]T(1,0)") == [
+        [0, 4], [1, 5], [2, 6], [3, 7],
+    ]
+
+
+def test_parse_collectives_good_fixture():
+    colls = parse_collectives(_fixture("good_fsdp.hlo"))
+    assert [c.kind for c in colls] == [
+        "all-gather", "all-reduce", "collective-permute", "reduce-scatter",
+    ]
+    ag, ar, cp, rs = colls
+    assert ag.result_shapes == (("f32", (16, 32)),)
+    assert ag.operand_shapes == (("f32", (8, 32)),)
+    assert ag.dims == (0,)
+    assert ag.groups == ((0, 2), (1, 3), (4, 6), (5, 7))
+    assert ag.channel_id == 1
+    assert "fsdp_param_gather" in ag.op_name
+    assert ar.groups == ((0, 4), (1, 5), (2, 6), (3, 7))
+    assert cp.groups == ((0, 1), (1, 0))  # source_target_pairs
+    assert rs.operand_shapes == (("f32", (16, 32)),)
+
+
+def test_traffic_model():
+    ag, ar, cp, rs = parse_collectives(_fixture("good_fsdp.hlo"))
+    # all-gather: out 16*32*4 B over G=2 -> (G-1)/G of the result
+    assert ag.traffic_bytes == 16 * 32 * 4 // 2
+    # all-reduce: 2*(G-1)/G of the buffer
+    assert ar.traffic_bytes == 2 * 32 * 32 * 4 // 2
+    # permute: whole buffer one hop
+    assert cp.traffic_bytes == 2 * 128 * 32 * 4
+    # reduce-scatter: (G-1)/G of the INPUT
+    assert rs.traffic_bytes == 16 * 32 * 4 // 2
+
+
+def test_parse_input_output_alias_and_params():
+    hlo = _fixture("good_fsdp.hlo")
+    aliases = parse_input_output_alias(hlo)
+    assert [(a.output_index, a.param_number, a.kind) for a in aliases] == [
+        ((0,), 0, "may-alias"), ((1,), 1, "may-alias"), ((2,), 2, "may-alias"),
+    ]
+    assert count_entry_parameters(hlo) == 4
+
+
+def test_dtypes_used():
+    assert "f64" not in dtypes_used(_fixture("good_fsdp.hlo"))
+    assert "f64" in dtypes_used(_fixture("bad_batch_allgather.hlo"))
+
+
+# ---------------------------------------------------------------------------
+# MeshInfo
+# ---------------------------------------------------------------------------
+
+
+def test_meshinfo_coords_and_axes():
+    assert MESH.n_devices == 8
+    assert MESH.coords(5) == (0, 1, 0, 0, 1)
+    assert MESH.crossed_axes([0, 4]) == ("replica",)
+    assert MESH.crossed_axes([0, 2]) == ("fsdp",)
+    assert MESH.crossed_axes([0, 1]) == ("tensor",)
+    assert MESH.crossed_axes([0, 1, 2, 3]) == ("fsdp", "tensor")
+    assert MESH.crossed_axes([3]) == ()
+
+
+def test_meshinfo_slices():
+    # num_slices=2 on replica=2: slice == replica coordinate
+    assert MESH_2SLICE.slice_of(0) == 0
+    assert MESH_2SLICE.slice_of(4) == 1
+    assert MESH_2SLICE.crosses_slice([0, 4])
+    assert not MESH_2SLICE.crosses_slice([0, 1, 2, 3])
+    # single-slice meshes never cross
+    assert not MESH.crosses_slice([0, 4])
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def test_no_batch_allgather_passes_on_good():
+    assert NoBatchAllGather().check(_analysis("good_fsdp.hlo")) == []
+
+
+def test_no_batch_allgather_fires_on_bad():
+    vs = NoBatchAllGather().check(_analysis("bad_batch_allgather.hlo"))
+    assert len(vs) == 1
+    assert "opaque_boundary_gather" in vs[0].line
+    # the rank-2 FSDP param gather in the same fixture is NOT flagged
+    assert "fsdp_param_gather" not in vs[0].line
+
+
+def test_no_batch_allgather_ignores_integer_index_gathers():
+    """The [B, T, 1] s32 token-id gather an embed-dim-sharded embedding
+    take emits is index plumbing (8 KB), not the activation trap."""
+    hlo = _fixture("bad_batch_allgather.hlo").replace(
+        "f32[8,256,64]", "s32[8,256,1]"
+    ).replace("f32[2,256,64]", "s32[1,256,1]")
+    a = StepAnalysis.from_text(hlo, MESH, global_batch=B, block=T)
+    assert NoBatchAllGather().check(a) == []
+
+
+def test_no_f64():
+    assert NoF64().check(_analysis("good_fsdp.hlo")) == []
+    vs = NoF64().check(_analysis("bad_batch_allgather.hlo"))
+    assert len(vs) == 1 and "f64" in vs[0].message
+
+
+def test_donation_intact():
+    assert DonationIntact().check(_analysis("good_fsdp.hlo", donated=3)) == []
+    vs = DonationIntact().check(
+        _analysis("bad_batch_allgather.hlo", donated=3)
+    )
+    assert len(vs) == 1 and "2 of 3" in vs[0].message
+
+
+def test_full_sequence_gather_rule():
+    hlo = (
+        "ENTRY %main {\n"
+        "  %all-gather.3 = bf16[2,8,256,32]{3,2,1,0} all-gather("
+        "bf16[2,8,128,32]{3,2,1,0} %p), channel_id=1, "
+        "replica_groups={{0,1}}, dimensions={2}, use_global_device_ids=true\n"
+        "}\n"
+    )
+    a = StepAnalysis.from_text(hlo, MESH, global_batch=B, block=T)
+    vs = NoFullSequenceGather().check(a)
+    assert len(vs) == 1
+    # and a feature-dim gather that does NOT reconstitute T is fine
+    ok = hlo.replace("bf16[2,8,256,32]", "bf16[2,8,128,64]").replace(
+        "dimensions={2}", "dimensions={3}"
+    )
+    a = StepAnalysis.from_text(ok, MESH, global_batch=B, block=T)
+    assert NoFullSequenceGather().check(a) == []
+
+
+def test_expect_collective():
+    a = _analysis("good_fsdp.hlo")
+    assert ExpectCollective("collective-permute").check(a) == []
+    a = _analysis("multislice_good.hlo", mesh=MESH_2SLICE)
+    vs = ExpectCollective("collective-permute", "ring missing").check(a)
+    assert len(vs) == 1 and "ring missing" in vs[0].message
+
+
+def test_dcn_allreduce_only():
+    good = _analysis("multislice_good.hlo", mesh=MESH_2SLICE)
+    assert DcnAllReduceOnly().check(good) == []
+    bad = _analysis("multislice_bad_dcn.hlo", mesh=MESH_2SLICE)
+    vs = DcnAllReduceOnly().check(bad)
+    assert len(vs) == 2
+    kinds = " ".join(v.message for v in vs)
+    assert "collective-permute" in kinds  # DCN permute
+    assert "activation-shaped" in kinds  # (b_local, T) all-reduce
+
+
+def test_cross_slice_grad_allreduce():
+    good = _analysis("multislice_good.hlo", mesh=MESH_2SLICE)
+    assert CrossSliceGradAllReduce().check(good) == []
+    # drop the cross-slice all-reduce: the sync-missing rule must fire
+    hlo = "\n".join(
+        l for l in _fixture("multislice_good.hlo").splitlines()
+        if "all-reduce" not in l
+    )
+    a = StepAnalysis.from_text(hlo, MESH_2SLICE, global_batch=B, block=T)
+    vs = CrossSliceGradAllReduce().check(a)
+    assert len(vs) == 1 and "divergently" in vs[0].message
+
+
+def test_ruleset_report_shape():
+    cfg = get_config("openwebtext_xl")
+    report = rules_for_config(cfg, MESH).evaluate(
+        _analysis("good_fsdp.hlo", donated=3)
+    )
+    assert report.ok
+    d = report.to_dict()
+    assert d["ok"] and {r["rule"] for r in d["rules"]} == {
+        "no-f64", "no-batch-allgather", "donation-intact",
+    }
+
+
+def test_rules_for_config_selects_by_parallelism():
+    msl = get_config("openwebtext_xl_multislice")
+    names = {r.name for r in rules_for_config(msl, MESH_2SLICE).rules}
+    assert {"dcn-allreduce-only", "cross-slice-grad-allreduce"} <= names
+
+    ring = get_config("openwebtext")
+    ring = dataclasses.replace(
+        ring, model=dataclasses.replace(ring.model, attn_impl="ring")
+    )
+    seq_mesh = dataclasses.replace(MESH, axis_sizes=(1, 1, 2, 4, 1))
+    names = {r.name for r in rules_for_config(ring, seq_mesh).rules}
+    assert {"seq-permute-not-gather", "expect-collective-permute"} <= names
+
+
+# ---------------------------------------------------------------------------
+# cost report
+# ---------------------------------------------------------------------------
+
+
+def test_cost_report_numbers():
+    rep = cost_report(_analysis("good_fsdp.hlo"))
+    assert rep["metric"] == "comms_traffic_bytes_per_step"
+    assert rep["unit"] == "bytes"
+    assert rep["collective_count"] == 4
+    # hand-computed from the fixture (see test_traffic_model)
+    assert rep["by_axis"] == {
+        "fsdp": 1024 + 1024, "replica": 4096, "tensor": 32768,
+    }
+    assert rep["value"] == 2048 + 4096 + 32768
+    assert rep["dcn_bytes"] == 0
+    assert rep["ici_bytes"] == rep["value"]
+    assert rep["by_kind"]["all-reduce"] == {
+        "count": 1, "traffic_bytes": 4096,
+    }
+    media = {c["medium"] for c in rep["collectives"]}
+    assert media == {"ici"}
+
+
+def test_cost_report_dcn_split():
+    rep = cost_report(_analysis("multislice_good.hlo", mesh=MESH_2SLICE))
+    # the iota-group all-reduce crosses slices; the fsdp gather does not
+    assert rep["dcn_bytes"] == 2 * 32 * 32 * 4 // 2
+    assert rep["ici_bytes"] == 16 * 32 * 4 // 2
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing (no compilation)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_override_parsing():
+    from midgpt_tpu.analysis.__main__ import _parse_override
+
+    assert _parse_override("batch=") == ("batch", None)
+    assert _parse_override("batch=fsdp") == ("batch", "fsdp")
+    assert _parse_override("batch=replica+fsdp") == (
+        "batch", ("replica", "fsdp"),
+    )
+
+
+def test_cli_unknown_config_is_usage_error(capsys):
+    from midgpt_tpu.analysis.__main__ import main
+
+    assert main(["--config", "no_such_config", "--mesh", "8"]) == 2
+
+
+def test_cli_unknown_override_axis_is_usage_error(capsys):
+    """A typo'd --override-logical-rule name exits 2 (usage), not 1 —
+    exit 1 is reserved for actual rule violations."""
+    from midgpt_tpu.analysis.__main__ import main
+
+    rc = main([
+        "--config", "openwebtext", "--mesh", "8",
+        "--override-logical-rule", "batsh=",
+    ])
+    assert rc == 2
+    assert "unknown logical axes" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# compile-backed regression tests (seconds, not milliseconds)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_sharded_cfg():
+    cfg = get_config("tiny")
+    return dataclasses.replace(
+        cfg,
+        batch_size=8,
+        g_accum_iters=1,
+        mesh=MeshConfig(replica=1, fsdp=2, sequence=2, tensor=2),
+    )
+
+
+def test_train_step_donation_intact():
+    """Compile the real donated train step and assert via the aliasing
+    audit that EVERY state buffer is reused — catches a silently-dropped
+    ``donate_argnums=(0,)`` in make_train_step, and the subtler partial
+    drop (un-constrained opt-state output shardings) this audit found."""
+    from midgpt_tpu.analysis.harness import analyze_train_step
+
+    a = analyze_train_step(_tiny_sharded_cfg(), shrink=False)
+    assert a.donated_leaves and a.donated_leaves > 0
+    assert DonationIntact().check(a) == [], (
+        f"aliased {len({e.param_number for e in a.aliases})} of "
+        f"{a.donated_leaves} donated buffers"
+    )
+
+
+def test_donation_audit_detects_undonated_jit():
+    """Negative control: the same audit on a jit WITHOUT donation reports
+    the drop (so a green donation test is meaningful)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(state):
+        return jax.tree.map(lambda a: a + 1, state)
+
+    hlo = (
+        jax.jit(f)  # no donate_argnums  # shardlint: disable=missing-donate
+        .lower({"w": jnp.zeros((8, 8))})
+        .compile()
+        .as_text()
+    )
+    one = MeshInfo(axis_names=("x",), axis_sizes=(1,))
+    a = StepAnalysis.from_text(hlo, one, donated_leaves=1)
+    assert len(DonationIntact().check(a)) == 1
+
+
+def test_train_step_comms_summary_scalars():
+    """The bench.py wiring: a flat scalar summary (total/DCN traffic,
+    collective count) that rides the one-JSON-line BENCH record."""
+    from midgpt_tpu.analysis.harness import train_step_comms_summary
+
+    s = train_step_comms_summary(_tiny_sharded_cfg())
+    assert set(s) == {
+        "comms_traffic_bytes_per_step",
+        "comms_dcn_bytes_per_step",
+        "comms_collective_count",
+    }
+    assert s["comms_traffic_bytes_per_step"] > 0  # FSDP/TP traffic exists
+    assert s["comms_dcn_bytes_per_step"] == 0  # single slice
+    assert s["comms_collective_count"] > 0
+    json.dumps(s)  # JSON-serializable scalars
+
+
+def test_cli_injected_batch_gather_fails_audit(tmp_path, capsys):
+    """Acceptance: a bad PartitionSpec (batch logical axis mapped to
+    nothing — the opaque-boundary trap) makes the CLI emit a
+    no-batch-allgather violation and exit non-zero; the clean run of the
+    same config exits zero. Runs in-process against the session's
+    8-device CPU pool."""
+    from midgpt_tpu.analysis.__main__ import main
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "--config", "openwebtext", "--mesh", "8",
+        "--override-logical-rule", "batch=",
+        "--json", str(out),
+    ])
+    assert rc == 1
+    rep = json.loads(out.read_text())
+    assert rep["ok"] is False
+    bad = {r["rule"] for r in rep["rules"] if not r["ok"]}
+    assert "no-batch-allgather" in bad
+    # the report still carries the cost section (audit != crash)
+    assert rep["cost"]["metric"] == "comms_traffic_bytes_per_step"
+    capsys.readouterr()  # swallow the JSON printed to stdout
+
+
+@pytest.mark.slow
+def test_cli_clean_config_passes(tmp_path, capsys):
+    from midgpt_tpu.analysis.__main__ import main
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "--config", "openwebtext", "--mesh", "8", "--json", str(out),
+    ])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["ok"] is True
+    assert rep["geometry"]["aliased_buffers"] == rep["geometry"]["donated_leaves"]
+    capsys.readouterr()

@@ -1,0 +1,274 @@
+"""Unit tests for the AST TPU-footgun lint (analysis.pylint_pass), plus
+the enforcement test that keeps the shipped tree lint-clean — the
+"zero unwaived findings on midgpt_tpu/" acceptance bar, made permanent.
+"""
+
+import pathlib
+import textwrap
+
+import midgpt_tpu
+from midgpt_tpu.analysis.pylint_pass import lint_paths, lint_source, unwaived
+
+
+def _lint(src: str):
+    return lint_source(textwrap.dedent(src), path="probe.py")
+
+
+def _rules(findings):
+    return [(f.rule, f.lineno) for f in findings if not f.waived]
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+
+def test_item_in_jitted_function_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        def step(state, x):
+            return state, x.item()
+
+        train = jax.jit(step, donate_argnums=(0,))
+        """
+    )
+    assert _rules(fs) == [("host-sync-in-jit", 5)]
+
+
+def test_host_sync_in_scan_body_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        def body(carry, xs):
+            v = jax.device_get(xs)
+            return carry, v
+
+        out = jax.lax.scan(body, 0, None)
+        """
+    )
+    assert ("host-sync-in-jit", 5) in _rules(fs)
+
+
+def test_np_asarray_in_traced_code_flagged():
+    fs = _lint(
+        """
+        import functools
+        import jax
+        import numpy as np
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            return np.asarray(x) + n
+        """
+    )
+    assert ("host-sync-in-jit", 8) in _rules(fs)
+
+
+def test_transitive_reference_into_jit_is_traced():
+    """jax.jit(wrapped) -> wrapped references step_fn -> step_fn's body
+    is traced too (the make_train_step shape)."""
+    fs = _lint(
+        """
+        import jax
+
+        def step_fn(state, x):
+            return state, x.item()
+
+        def wrapped(state, x):
+            return step_fn(state, x)
+
+        train = jax.jit(wrapped, donate_argnums=(0,))
+        """
+    )
+    assert ("host-sync-in-jit", 5) in _rules(fs)
+
+
+def test_host_code_not_flagged():
+    fs = _lint(
+        """
+        import numpy as np
+
+        def load(path):
+            x = np.asarray(open(path).read())
+            return x.item()
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_jnp_asarray_not_flagged():
+    fs = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x)
+        """
+    )
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# unknown-mesh-axis
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_axis_literal_flagged():
+    fs = _lint(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("fsdp", "tenzor")
+        """
+    )
+    assert _rules(fs) == [("unknown-mesh-axis", 4)]
+
+
+def test_known_axes_and_tuples_pass():
+    fs = _lint(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        a = P(None, ("replica", "fsdp"), "sequence")
+        b = P("pipeline", "fsdp", "tensor")
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_non_spec_strings_not_checked():
+    fs = _lint(
+        """
+        def shard_act(x, *names):
+            return x
+
+        y = shard_act(None, "batch", "seq", "embed")  # logical, not mesh
+        """
+    )
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# missing-donate
+# ---------------------------------------------------------------------------
+
+
+def test_jit_on_state_fn_without_donation_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        def step(state, x):
+            return state
+
+        train = jax.jit(step)
+        """
+    )
+    assert _rules(fs) == [("missing-donate", 7)]
+
+
+def test_jit_with_donation_passes():
+    fs = _lint(
+        """
+        import jax
+
+        def step(state, x):
+            return state
+
+        train = jax.jit(step, donate_argnums=(0,))
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_non_state_jit_not_flagged():
+    fs = _lint(
+        """
+        import jax
+
+        def eval_fn(params, xs):
+            return xs
+
+        ev = jax.jit(eval_fn)
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_decorated_state_fn_flagged():
+    fs = _lint(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def step(state, n):
+            return state
+        """
+    )
+    assert _rules(fs) == [("missing-donate", 5)]
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_waives_named_rule():
+    fs = _lint(
+        """
+        import jax
+
+        def step(state, x):
+            return state
+
+        train = jax.jit(step)  # shardlint: disable=missing-donate
+        """
+    )
+    assert _rules(fs) == []
+    assert [(f.rule, f.waived) for f in fs] == [("missing-donate", True)]
+
+
+def test_bare_pragma_waives_all():
+    fs = _lint(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("tenzor")  # shardlint: disable
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_pragma_on_other_line_does_not_waive():
+    fs = _lint(
+        """
+        import jax
+        # shardlint: disable=missing-donate
+
+        def step(state, x):
+            return state
+
+        train = jax.jit(step)
+        """
+    )
+    assert _rules(fs) == [("missing-donate", 8)]
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree stays clean
+# ---------------------------------------------------------------------------
+
+
+def test_midgpt_tpu_tree_is_lint_clean():
+    """The acceptance bar of the analysis PR, kept as an invariant:
+    zero unwaived findings over the whole package. New waivers must be
+    explicit inline pragmas, which show up in diffs."""
+    pkg = pathlib.Path(midgpt_tpu.__file__).parent
+    findings = unwaived(lint_paths([pkg]))
+    assert findings == [], "\n".join(str(f) for f in findings)

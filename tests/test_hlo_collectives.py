@@ -3,19 +3,23 @@
 SURVEY.md §7 lists "verifying with compiler comms reports" as a hard part:
 loss-parity dryruns prove the sharded step is *correct*, not that GSPMD
 produced the intended collectives. These tests compile the real train step
-(shrunk layer/seq/vocab sizes, same mesh axes and code paths) on the
-8-device CPU mesh and parse ``.lower().compile().as_text()``:
+(audit-shrunk layer/seq/vocab sizes, same mesh axes and code paths) on the
+8-device CPU mesh and evaluate each config's declared ruleset from
+``midgpt_tpu.analysis`` — the parsing/rule machinery itself has fast
+fixture-based unit tests in test_analysis.py; what THESE tests pin is the
+real compiled artifacts of the shipped configs:
 
 - **No batch-dim all-gather of activations** in any sharded config. The
   known trap class: an opaque boundary (e.g. a bare ``pallas_call``)
   makes the partitioner gather the full batch onto every device. Feature
   -dim activation all-gathers are legitimate TP traffic and are allowed.
 - **Multislice DCN contract** (SURVEY.md §2.6: DP-only across slices):
-  every collective whose device group crosses the replica (slice) axis
-  must be an all-reduce (gradient/loss sums) with no activation-shaped
-  operand — FSDP/TP gathers and permutes must stay inside a slice. The
-  cross-slice gradient all-reduce must also EXIST (a step with no
-  replica sync at all would silently train divergent replicas).
+  cross-slice traffic is all-reduce-only, and the cross-slice gradient
+  all-reduce must EXIST.
+- **Ring attention** moves K/V by collective-permute hops, never by
+  reconstituting the full sequence (SURVEY.md §5.7).
+- **Donation sticks**: the donated train state is fully aliased
+  input->output (the rule that caught the dropped Adam-moment donation).
 
 Caveat: Mosaic kernels don't lower on CPU, so the pallas path itself is
 exercised by the shard_map parity tests (test_fused_attn.py); this audit
@@ -23,156 +27,37 @@ guards the partitioner's output for everything GSPMD handles.
 """
 
 import dataclasses
-import re
 
-import numpy as np
 import pytest
 
-import jax
-from jax.sharding import PartitionSpec as P
-
+from midgpt_tpu.analysis import MeshInfo, StepAnalysis, rules_for_config
+from midgpt_tpu.analysis.harness import (
+    analyze_train_step,
+    compile_eval_sweep,
+    shrink_for_audit,
+)
+from midgpt_tpu.analysis.rules import NoBatchAllGather
 from midgpt_tpu.config import get_config
-from midgpt_tpu.parallel.mesh import create_mesh
-from midgpt_tpu.parallel.sharding import make_global_array
-from midgpt_tpu.train import init_state, make_optimizer, make_train_step
-
-BLOCK = 256
-BATCH = 8
-
-_COLL = re.compile(
-    r"\b(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
-    r"(?:-start)?\("
-)
-_GROUPS = re.compile(
-    r"replica_groups=(\{\{.*?\}\}|\[[^\]]*\]<=\[[^\]]*\](?:T\([^)]*\))?)"
-)
-_PAIRS = re.compile(r"source_target_pairs=(\{\{.*?\}\})")
-_SHAPE = re.compile(r"[a-z0-9]+\[([0-9,]*)\]")
-_DIMS = re.compile(r"dimensions=\{([0-9,]+)\}")
 
 
-def _parse_groups(spec: str):
-    """replica_groups / source_target_pairs -> list of device-id groups."""
-    if spec.startswith("{{"):
-        return [
-            [int(x) for x in g.split(",") if x.strip() != ""]
-            for g in re.findall(r"\{([0-9,]+)\}", spec)
-        ]
-    # iota form: [G,S]<=[N...] optionally with a transpose suffix
-    m = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](T\(([0-9,]+)\))?", spec)
-    assert m, f"unparsed replica_groups {spec!r}"
-    gshape = [int(x) for x in m.group(1).split(",")]
-    rshape = [int(x) for x in m.group(2).split(",")]
-    ids = np.arange(int(np.prod(rshape))).reshape(rshape)
-    if m.group(3):
-        ids = np.transpose(ids, [int(x) for x in m.group(4).split(",")])
-    ids = ids.reshape(gshape)
-    return [list(map(int, row)) for row in ids]
+def _audit(cfg):
+    """(analysis, report) for a config's audit-shrunk train step."""
+    analysis = analyze_train_step(cfg)
+    report = rules_for_config(cfg, analysis.mesh).evaluate(analysis)
+    return analysis, report
 
 
-def _collectives(hlo: str):
-    """[(kind, line, groups, out_shapes, gather_dims)] for every collective."""
-    out = []
-    for line in hlo.splitlines():
-        m = _COLL.search(line)
-        if m is None or "=" not in line:
-            continue
-        kind = m.group(1)
-        gm = _GROUPS.search(line)
-        pm = _PAIRS.search(line)
-        if gm:
-            groups = _parse_groups(gm.group(1))
-        elif pm:
-            # each {src,dst} pair is a 2-device "group" for crossing checks
-            groups = _parse_groups(pm.group(1))
-        else:
-            groups = []
-        # result shapes live between "=" and the op keyword (handles both
-        # scalar `f32[..] all-reduce(` and variadic `(f32[..], ..) all-reduce(`)
-        head = line[: m.start()]
-        head = head.split(" = ", 1)[1] if " = " in head else head
-        shapes = [
-            tuple(int(x) for x in s.split(",") if x != "")
-            for s in _SHAPE.findall(head)
-        ]
-        dm = _DIMS.search(line)
-        dims = [int(x) for x in dm.group(1).split(",")] if dm else []
-        out.append((kind, line.strip(), groups, shapes, dims))
-    return out
-
-
-def _shrunk(name: str):
-    cfg = get_config(name)
-    model = dataclasses.replace(
-        cfg.model,
-        n_layer=2,
-        block_size=BLOCK,
-        vocab_size=1024,
-        remat="none",
-        scan_unroll=1,
-    )
-    return dataclasses.replace(
-        cfg,
-        model=model,
-        batch_size=BATCH,
-        g_accum_iters=1,
-        loss_chunk=128,  # 2 chunks: keeps the chunked-loss path in the audit
-    )
-
-
-def _compile_cfg(cfg):
-    mesh = create_mesh(cfg.mesh)
-    tx, _ = make_optimizer(cfg)
-    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, tx, mesh)
-    x = np.zeros((1, BATCH, BLOCK), np.int32)
-    spec = P(None, ("replica", "fsdp"), "sequence")
-    xg = make_global_array(x, mesh, spec)
-    txt = step.lower(state, xg, xg, jax.random.PRNGKey(1)).compile().as_text()
-    return txt, mesh
-
-
-def _compile_step(name: str):
-    return _compile_cfg(_shrunk(name))
-
-
-def _local_batch(mesh) -> int:
-    shape = dict(mesh.shape)
-    return BATCH // (shape.get("replica", 1) * shape.get("fsdp", 1))
-
-
-def _local_t(mesh) -> int:
-    return BLOCK // dict(mesh.shape).get("sequence", 1)
-
-
-def _assert_no_batch_gather(colls, mesh):
-    """No all-gather over dim 0 of a [B_local, T_local, ...] activation."""
-    b_local = _local_batch(mesh)
-    t_local = _local_t(mesh)
-    for kind, line, _, shapes, dims in colls:
-        if kind != "all-gather":
-            continue
-        for shape in shapes:
-            # activations are rank>=3 [B, T, ...]; rank-2 gathers are FSDP
-            # param shards (legitimate), feature-dim gathers are TP. The
-            # sequence dim carries T_local on sequence-sharded meshes.
-            if (
-                len(shape) >= 3
-                and 0 in dims
-                and shape[1] in (t_local, BLOCK)
-                and shape[0] >= b_local
-            ):
-                raise AssertionError(
-                    f"batch-dim all-gather of an activation:\n{line}"
-                )
+def _assert_ok(report):
+    assert report.ok, "\n".join(str(v) for v in report.violations)
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", ["openwebtext_xl", "llama_7b"])
 def test_sharded_config_has_no_batch_allgather(name):
-    hlo, mesh = _compile_step(name)
-    assert dict(mesh.shape)["tensor"] == 4  # the shipped FSDP x TP shape
-    _assert_no_batch_gather(_collectives(hlo), mesh)
+    cfg = get_config(name)
+    analysis, report = _audit(cfg)
+    assert analysis.mesh.shape["tensor"] == 4  # the shipped FSDP x TP shape
+    _assert_ok(report)
 
 
 @pytest.mark.slow
@@ -180,8 +65,9 @@ def test_ring_config_permutes_instead_of_gathering_seq():
     """A sequence-sharded ring-attention train step must move K/V with
     collective-permutes (the ring hops), never by all-gathering the full
     sequence onto every device — the anti-pattern ring attention exists
-    to avoid (SURVEY.md §5.7)."""
-    cfg = _shrunk("openwebtext")
+    to avoid (SURVEY.md §5.7). rules_for_config adds the ring rules
+    (seq-permute-not-gather + expect-collective-permute) for this config."""
+    cfg = get_config("openwebtext")
     cfg = dataclasses.replace(
         cfg,
         model=dataclasses.replace(cfg.model, attn_impl="ring"),
@@ -189,71 +75,23 @@ def test_ring_config_permutes_instead_of_gathering_seq():
             cfg.mesh, replica=1, fsdp=2, sequence=4, tensor=1
         ),
     )
-    hlo, mesh = _compile_cfg(cfg)
-
-    colls = _collectives(hlo)
-    assert any(k == "collective-permute" for k, *_ in colls), (
-        "no collective-permute found — the ring schedule is not in the "
-        "compiled step"
-    )
-    for kind, line, _, shapes, dims in colls:
-        if kind != "all-gather":
-            continue
-        for shape in shapes:
-            # no rank>=3 activation gather that reconstitutes the full T:
-            # a gathered dim (ANY position >= 1 — K/V sit at [B,H,T,C] with
-            # T at dim 2 inside attention) reaching full BLOCK size
-            if len(shape) >= 3 and any(
-                d >= 1 and d < len(shape) and shape[d] == BLOCK for d in dims
-            ):
-                raise AssertionError(
-                    f"full-sequence all-gather of an activation:\n{line}"
-                )
-    _assert_no_batch_gather(colls, mesh)
+    analysis, report = _audit(cfg)
+    assert {r.rule for r in report.results} >= {
+        "seq-permute-not-gather", "expect-collective-permute"
+    }
+    _assert_ok(report)
 
 
 @pytest.mark.slow
 def test_multislice_dcn_contract():
-    hlo, mesh = _compile_step("openwebtext_xl_multislice")
-    colls = _collectives(hlo)
-    shape = dict(mesh.shape)
-    assert shape["replica"] == 2
-
-    # device id -> slice (replica coordinate): logical ids in the HLO are
-    # positions in the mesh device assignment
-    devs = mesh.devices
-    rep_axis = mesh.axis_names.index("replica")
-    flat_ids = np.vectorize(lambda d: d.id)(devs).flatten()
-    coords = {
-        int(flat_ids[i]): int(np.unravel_index(i, devs.shape)[rep_axis])
-        for i in range(flat_ids.size)
+    cfg = get_config("openwebtext_xl_multislice")
+    analysis, report = _audit(cfg)
+    assert analysis.mesh.shape["replica"] == 2
+    assert analysis.mesh.num_slices == 2
+    assert {r.rule for r in report.results} >= {
+        "dcn-allreduce-only", "cross-slice-grad-allreduce"
     }
-
-    def crosses(groups):
-        return any(len({coords[d] for d in g}) > 1 for g in groups if g)
-
-    b_local = _local_batch(mesh)
-    saw_cross_reduce = False
-    for kind, line, groups, shapes, _ in colls:
-        if not crosses(groups):
-            continue
-        # DP-only over DCN: the only traffic allowed across slices is
-        # all-reduce (grad/loss sums) of non-activation operands
-        assert kind == "all-reduce", (
-            f"{kind} crosses the slice boundary (DCN):\n{line}"
-        )
-        for shape in shapes:
-            assert not (len(shape) >= 2 and shape[:2] == (b_local, BLOCK)), (
-                f"activation-shaped all-reduce crosses slices:\n{line}"
-            )
-        if any(len(s) >= 2 for s in shapes):
-            saw_cross_reduce = True  # param-shaped gradient sync
-    assert saw_cross_reduce, (
-        "no cross-slice gradient all-reduce found — replicas would train "
-        "divergently (DP sync missing from the compiled step)"
-    )
-
-    _assert_no_batch_gather(colls, mesh)
+    _assert_ok(report)
 
 
 @pytest.mark.slow
@@ -261,19 +99,15 @@ def test_eval_sweep_has_no_batch_allgather():
     """The r5 eval sweep (make_eval_step: all eval batches through one
     lax.scan) must shard like the train step — a batch-dim gather inside
     the scan body would cost eval_batches x the train-step trap."""
-    from midgpt_tpu.train import make_eval_step
-
-    cfg = _shrunk("openwebtext_xl")
-    mesh = create_mesh(cfg.mesh)
-    tx, _ = make_optimizer(cfg)
-    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0))
-    sweep = make_eval_step(cfg, mesh)
-    n_eval = 3
-    x = np.zeros((n_eval, BATCH, BLOCK), np.int32)
-    spec = P(None, ("replica", "fsdp"), "sequence")
-    xg = make_global_array(x, mesh, spec)
-    hlo = sweep.lower(state.params, xg, xg).compile().as_text()
-    _assert_no_batch_gather(_collectives(hlo), mesh)
+    cfg = shrink_for_audit(get_config("openwebtext_xl"))
+    hlo, mesh = compile_eval_sweep(cfg, n_eval=3)
+    analysis = StepAnalysis.from_text(
+        hlo,
+        MeshInfo.from_mesh(mesh),
+        global_batch=cfg.microbatch_size,
+        block=cfg.model.block_size,
+    )
+    assert not NoBatchAllGather().check(analysis)
 
 
 @pytest.mark.slow
@@ -281,8 +115,9 @@ def test_moe_ep_step_has_no_batch_allgather():
     """MoE under fsdp x tensor (expert parallelism): the one-hot
     dispatch/combine einsums must not make GSPMD gather full activations
     — batch stays sharded; the expert contraction's psum is the only
-    intended cross-'tensor' traffic."""
-    cfg = _shrunk("openwebtext")
+    intended cross-'tensor' traffic (rules_for_config adds the
+    expect-all-reduce rule for MoE configs)."""
+    cfg = get_config("openwebtext")
     cfg = dataclasses.replace(
         cfg,
         model=dataclasses.replace(
@@ -292,9 +127,6 @@ def test_moe_ep_step_has_no_batch_allgather():
             cfg.mesh, replica=1, fsdp=2, sequence=1, tensor=4
         ),
     )
-    hlo, mesh = _compile_cfg(cfg)
-    colls = _collectives(hlo)
-    _assert_no_batch_gather(colls, mesh)
-    assert any(k == "all-reduce" for k, *_ in colls), (
-        "no all-reduce found — the expert-combine psum is missing"
-    )
+    analysis, report = _audit(cfg)
+    assert "expect-all-reduce" in {r.rule for r in report.results}
+    _assert_ok(report)
