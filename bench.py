@@ -33,6 +33,19 @@ import numpy as np
 
 BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
 
+# steps per timing sample: the scan-mode long chain fuses _SCAN_STEPS + 1
+# optimizer steps into one dispatch (train.make_train_window)
+_SCAN_STEPS = 10
+
+
+def _fused_len(mode: str, n_steps: int = _SCAN_STEPS) -> int:
+    """Optimizer steps fused per dispatch of the program _rung_measure
+    timed: the scan path's long sample compiles make_scan(n_steps + 1)
+    (the trainer's steps_per_dispatch knob); chained fallback is one
+    step per dispatch. Single source of truth for the JSON record —
+    must mirror _measure_scan's n-vs-(n+1) construction."""
+    return n_steps + 1 if mode == "scan" else 1
+
 
 def _run_config(
     remat: str, batch: int, base: str = "openwebtext", n_layer=None,
@@ -104,22 +117,35 @@ def _run_config(
         return time.perf_counter() - start, state
 
     def make_scan(n: int):
-        # n steps inside ONE dispatch (see _measure_scan). Calling the
-        # jitted train_step inside jit inlines its jaxpr.
-        def multi(state):
-            def body(s, _):
-                s2, loss = train_step(s, xg, yg, key)
-                return s2, loss
+        # n steps inside ONE dispatch (see _measure_scan) — the SAME fused
+        # window program the trainer ships (train.make_train_window with
+        # steps_per_dispatch=n), not a parallel hand-rolled scan: what
+        # bench times is the program train() launches. The window consumes
+        # an [n, G, B, T] device-resident batch window; bench replicates
+        # one batch n times (timing, not training).
+        from midgpt_tpu.train import make_train_window
 
-            s, losses = jax.lax.scan(body, state, None, length=n)
-            return s, losses[-1]
+        window = make_train_window(cfg, tx, mesh, n)
+        wspec = P(None, *spec)
+        xs = make_global_array(
+            np.ascontiguousarray(np.broadcast_to(x, (n,) + x.shape)),
+            mesh, wspec,
+        )
+        ys = make_global_array(
+            np.ascontiguousarray(np.broadcast_to(y, (n,) + y.shape)),
+            mesh, wspec,
+        )
+
+        def multi(state):
+            state, out = window(state, xs, ys, key)
+            return state, out["loss"][-1]
 
         return jax.jit(multi, donate_argnums=(0,))
 
     return cfg, state, chain, make_scan
 
 
-def _measure(cfg, state, chain, n_steps: int = 10, repeats: int = 3):
+def _measure(cfg, state, chain, n_steps: int = _SCAN_STEPS, repeats: int = 3):
     """(tokens/sec, step_ms) from chained-steps deltas; median of
     ``repeats`` measures (single measures spread ~2% run-to-run on this
     chip — relay jitter + clock variation).
@@ -138,7 +164,9 @@ def _measure(cfg, state, chain, n_steps: int = 10, repeats: int = 3):
     return tokens_per_sec, 1e3 * step_s, state
 
 
-def _measure_scan(cfg, state, make_scan, n_steps: int = 10, repeats: int = 3):
+def _measure_scan(
+    cfg, state, make_scan, n_steps: int = _SCAN_STEPS, repeats: int = 3
+):
     """(tokens/sec, step_ms) like _measure, but each timing sample runs
     its steps inside ONE ``lax.scan`` dispatch, so per-dispatch relay
     latency appears once per sample and cancels in the 1-vs-(n+1) delta
@@ -325,6 +353,9 @@ def main() -> None:
                 "batch_per_chip": xcfg.batch_size // n_dev,
                 "model_flops_per_token": flops_per_token(xcfg.model),
                 "measure": xmode,
+                # fused dispatch length of the measured program (the
+                # trainer's steps_per_dispatch knob; 1 = chained fallback)
+                "steps_per_dispatch": _fused_len(xmode),
             })
             del xstate, xchain
             gc.collect()

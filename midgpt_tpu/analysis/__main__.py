@@ -14,6 +14,11 @@ activation logical-rule table before compiling — ``batch=`` (empty =
 unsharded) reproduces the opaque-boundary batch-gather trap, which is
 how the test suite proves the audit fails loudly.
 
+``--steps-per-dispatch K`` audits the fused K-step window program
+(train.make_train_window) instead of the per-step jit — the CI gate that
+catches donation-across-the-window (or host-callback) regressions on a
+CPU mesh instead of a TPU run.
+
 Platform note: env setup must precede the first jax import, which is why
 this module parses args and sets ``JAX_PLATFORMS``/``XLA_FLAGS`` before
 touching the harness; on hosts whose site config pins a platform the
@@ -58,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--no-shrink", action="store_true",
         help="compile the config at full size instead of audit size",
+    )
+    p.add_argument(
+        "--steps-per-dispatch", type=int, default=None, metavar="K",
+        help="audit the fused K-step window program (train.make_train_window)"
+        " instead of the per-step jit; default: the config's own value",
     )
     p.add_argument(
         "--override-logical-rule", action="append", default=[],
@@ -146,6 +156,19 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     except KeyError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.steps_per_dispatch is not None:
+        if args.steps_per_dispatch < 1:
+            print(
+                f"error: --steps-per-dispatch must be >= 1, got "
+                f"{args.steps_per_dispatch}",
+                file=sys.stderr,
+            )
+            return 2
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, steps_per_dispatch=args.steps_per_dispatch
+        )
 
     overrides = dict(args.override_logical_rule) or None
     if overrides:
@@ -177,6 +200,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         "geometry": {
             "global_batch": analysis.global_batch,
             "block": analysis.block,
+            "steps_per_dispatch": cfg.steps_per_dispatch,
             "donated_leaves": analysis.donated_leaves,
             "aliased_buffers": len({e.param_number for e in analysis.aliases}),
         },

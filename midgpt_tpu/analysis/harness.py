@@ -87,27 +87,42 @@ def compile_train_step(
     cfg: ExperimentConfig,
     logical_overrides: tp.Optional[tp.Mapping[str, tp.Any]] = None,
 ):
-    """Compile the real donated train step for ``cfg`` on the current
-    backend's devices. Returns ``(hlo_text, mesh, donated_leaves)``."""
+    """Compile the real donated train program for ``cfg`` on the current
+    backend's devices — the per-step jit when ``steps_per_dispatch == 1``,
+    the fused K-step ``make_train_window`` scan otherwise (so the audit
+    sees exactly the program the trainer launches, incl. donation across
+    the whole window). Returns ``(hlo_text, mesh, donated_leaves)``."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from midgpt_tpu.parallel.mesh import create_mesh
     from midgpt_tpu.parallel.sharding import make_global_array
-    from midgpt_tpu.train import init_state, make_optimizer, make_train_step
+    from midgpt_tpu.train import (
+        init_state,
+        make_optimizer,
+        make_train_step,
+        make_train_window,
+    )
 
     mesh = create_mesh(cfg.mesh)
     tx, _ = make_optimizer(cfg)
+    k = cfg.steps_per_dispatch
     with override_logical_rules(logical_overrides):
         # abstract: sharded ShapeDtypeStructs, not device buffers — the
         # audit lowers/compiles but never executes, so full-size configs
         # (bench.py's comms rung) don't pay params + Adam moments in HBM
         state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0), abstract=True)
-        step = make_train_step(cfg, tx, mesh)
         b = cfg.microbatch_size
         t = cfg.model.block_size
-        x = np.zeros((cfg.g_accum_iters, b, t), np.int32)
-        xg = make_global_array(x, mesh, P(*BATCH_SPEC_AXES))
+        if k > 1:
+            step = make_train_window(cfg, tx, mesh, k)
+            x = np.zeros((k, cfg.g_accum_iters, b, t), np.int32)
+            spec = P(None, *BATCH_SPEC_AXES)
+        else:
+            step = make_train_step(cfg, tx, mesh)
+            x = np.zeros((cfg.g_accum_iters, b, t), np.int32)
+            spec = P(*BATCH_SPEC_AXES)
+        xg = make_global_array(x, mesh, spec)
         hlo = step.lower(
             state, xg, xg, jax.random.PRNGKey(1)
         ).compile().as_text()

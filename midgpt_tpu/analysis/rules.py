@@ -22,6 +22,12 @@ The built-in rules encode the repo's sharding invariants (previously
 - ``donation-intact`` — ``donate_argnums`` actually produced
   input/output buffer aliases (donation silently drops when shapes,
   layouts, or shardings stop matching).
+- ``no-host-sync`` — nothing in the compiled program round-trips through
+  the host (infeed/outfeed, host-transfer send/recv, python-callback
+  custom-calls). Matters most for the fused K-step dispatch
+  (``steps_per_dispatch``): a stray ``debug.print``/``pure_callback``
+  inside the window would stall the whole K-step launch on the host,
+  resurrecting exactly the per-dispatch latency the fusion amortizes.
 
 New parallel configs pick their rules via :func:`rules_for_config`
 (or build a custom list) instead of copy-pasting regexes.
@@ -30,6 +36,7 @@ New parallel configs pick their rules via :func:`rules_for_config`
 from __future__ import annotations
 
 import dataclasses
+import re
 import typing as tp
 
 from midgpt_tpu.analysis import hlo as hlo_mod
@@ -281,6 +288,56 @@ class NoF64(Rule):
         )]
 
 
+class NoHostSync(Rule):
+    """No host round-trips inside the compiled step: infeed/outfeed ops,
+    send/recv with ``is_host_transfer=true``, or python-callback
+    custom-calls (``pure_callback``/``io_callback``/``debug.print`` lower
+    to ``custom_call_target="xla_*_callback"``). Any of these serializes
+    the program against the host — and inside a fused K-step window
+    (steps_per_dispatch) it stalls all K steps per launch, undoing the
+    dispatch-latency amortization the fusion exists for."""
+
+    name = "no-host-sync"
+    description = "no host callbacks / infeed / outfeed in the step"
+
+    # the op kind sits between the result shape (possibly a nested tuple)
+    # and its operand list: preceded by whitespace/'='/')', never by the
+    # '%' of an instruction-name reference
+    _OP = re.compile(
+        r"[=\s)](infeed|outfeed|send|recv|custom-call)"
+        r"(?:-(?:done|start))?\("
+    )
+    _CALLBACK = re.compile(
+        r'custom_call_target="[^"]*callback[^"]*"', re.I
+    )
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        out = []
+        for line in a.hlo.splitlines():
+            m = self._OP.search(line)
+            if not m:
+                continue
+            kind = m.group(1)
+            if kind in ("infeed", "outfeed"):
+                out.append(self.violation(
+                    f"{kind} in the compiled step (host transfer)",
+                    line.strip(),
+                ))
+            elif kind in ("send", "recv"):
+                if "is_host_transfer=true" in line:
+                    out.append(self.violation(
+                        f"host-transfer {kind} in the compiled step",
+                        line.strip(),
+                    ))
+            elif self._CALLBACK.search(line):
+                out.append(self.violation(
+                    "python-callback custom-call in the compiled step "
+                    "(pure_callback / io_callback / debug.print)",
+                    line.strip(),
+                ))
+        return out
+
+
 class DonationIntact(Rule):
     """``donate_argnums`` actually stuck: the executable aliases at least
     ``donated_leaves`` parameter buffers to outputs. XLA silently drops
@@ -374,6 +431,7 @@ def rules_for_config(cfg, mesh: MeshInfo) -> RuleSet:
         NoF64(),
         NoBatchAllGather(),
         DonationIntact(),
+        NoHostSync(),
     ]
     shape = mesh.shape
     if cfg.model.attn_impl == "ring" and shape.get(SEQUENCE_AXIS, 1) > 1:

@@ -39,7 +39,10 @@ class ModelConfig:
     moe_experts: int = 8  # experts per MoE layer (mlp="moe")
     moe_top_k: int = 1  # experts per token: 1 = Switch, 2 = GShard-style
     # (renormalized top-2 gates; aux loss tracks first choices)
-    moe_capacity: float = 1.25  # per-row capacity factor: C = cf * T / E
+    # per-row capacity factor: C = ceil(cf * top_k * T / E) — K claims per
+    # token share the expert buffers, so capacity scales with top_k
+    # (models/gpt.MoEMLP.__call__)
+    moe_capacity: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance aux loss weight (train)
     mlp_ratio: float = 4.0  # hidden = ratio * n_embd (swiglu: per-branch width)
     # exact hidden width; None = ratio * n_embd, with FRACTIONAL products
@@ -147,6 +150,14 @@ class ExperimentConfig:
     max_steps: int = 5000
     batch_size: int = 32  # GLOBAL batch size (train.py:31)
     g_accum_iters: int = 1
+    # optimizer steps fused into ONE jitted lax.scan dispatch
+    # (train.make_train_window): amortizes the fixed per-dispatch host/
+    # runtime latency over K steps (PERF.md r5 measured +25-50 ms/step of
+    # pure dispatch overhead on a bad relay day). 1 = today's one-dispatch-
+    # per-step loop, bit-for-bit. K > 1 requires eval/ckpt intervals to be
+    # multiples of K (resolve_dispatch_intervals — intervals get window
+    # granularity) and holds a K-deep batch window in HBM.
+    steps_per_dispatch: int = 1
     beta1: float = 0.9
     beta2: float = 0.95
     weight_decay: float = 1e-4
@@ -231,6 +242,54 @@ def from_json(s: str) -> ExperimentConfig:
 
 def from_dict(d: tp.Mapping[str, tp.Any]) -> ExperimentConfig:
     return _from_dict(ExperimentConfig, d)
+
+
+# ---------------------------------------------------------------------------
+# steps_per_dispatch interval resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_dispatch_intervals(cfg: ExperimentConfig) -> ExperimentConfig:
+    """Validate/align the interval knobs against ``steps_per_dispatch``.
+
+    With K steps fused into one dispatch, the host only sees the train
+    state at window boundaries (multiples of K), so anything that needs
+    the state *between* steps — eval sweeps, checkpoint saves — must land
+    on the K grid. Misaligned explicit intervals FAIL FAST here with an
+    actionable message instead of silently skewing the eval/ckpt cadence.
+
+    ``log_interval`` needs no alignment: per-step (loss, grad-norm, lr)
+    come back as stacked scan outputs of the fused window, so logging
+    stays per-step exact at any cadence with at most one host sync per
+    logging window. ``ckpt_interval=None`` resolves to ``eval_interval``
+    (already validated). ``max_steps`` need not divide K — the final
+    window is a shorter program (ceil(max_steps / K) dispatches total).
+
+    K=1 returns ``cfg`` unchanged (the identical object): the trainer
+    keeps today's one-dispatch-per-step loop and jitted step.
+    """
+    k = cfg.steps_per_dispatch
+    if k == 1:
+        return cfg
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+
+    def _aligned(name: str, value: int) -> None:
+        if value % k != 0:
+            lo, hi = (value // k) * k, -(-value // k) * k
+            suggestion = f"{hi}" if lo == 0 else f"{lo} or {hi}"
+            raise ValueError(
+                f"{name}={value} is not divisible by steps_per_dispatch={k}: "
+                f"the fused window only exposes the train state every {k} "
+                f"steps, so the {name.split('_')[0]} cadence would silently "
+                f"skew to window boundaries. Set {name} to a multiple of {k} "
+                f"(e.g. {suggestion}) or change steps_per_dispatch."
+            )
+
+    _aligned("eval_interval", cfg.eval_interval)
+    if cfg.ckpt_interval is not None:
+        _aligned("ckpt_interval", cfg.ckpt_interval)
+    return cfg
 
 
 # ---------------------------------------------------------------------------

@@ -149,6 +149,12 @@ class _PrefetchError:
         self.exc = exc
 
 
+class _PlanExhausted:
+    """Terminal sentinel enqueued when a finite window_plan runs out, so a
+    next() past the plan raises instead of blocking forever on an empty
+    queue (the worker has exited)."""
+
+
 class PrefetchLoader:
     """Background-thread prefetch around a Loader: the next batch is
     gathered (and optionally pushed to device) while the current train step
@@ -160,6 +166,17 @@ class PrefetchLoader:
     thread — jax.device_put / make_array_from_process_local_data are
     thread-safe for this producer/consumer pattern.
 
+    **Window mode** (``window > 1`` or an explicit ``window_plan``): each
+    produced item stacks W consecutive batches along a new leading axis —
+    ``[W, *batch_shape, T]`` — feeding the fused multi-step dispatch
+    (train.make_train_window) one K-deep batch window per launch.
+    ``window_plan`` is the trainer's finite per-item size schedule (a
+    short first window re-aligns an off-grid resume; a short last window
+    covers ``max_steps % K``); the worker stops when the plan runs out.
+    Consumption is accounted in LOADER STEPS: ``state_dict`` counts only
+    the batches of consumed windows, so stop/resume mid-window replays
+    every unconsumed window batch exactly.
+
     Checkpointing goes through the wrapped loader's state_dict; the
     prefetch queue is drained on load so resumed batches are exact.
     """
@@ -169,40 +186,82 @@ class PrefetchLoader:
         loader: Loader,
         transform: tp.Optional[tp.Callable] = None,
         depth: int = 2,
+        window: int = 1,
+        window_plan: tp.Optional[tp.Sequence[int]] = None,
     ):
+        assert window >= 1, window
         self.loader = loader
         self._transform = transform if transform is not None else lambda *b: b
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread: tp.Optional[threading.Thread] = None
+        self._window = window
+        self._plan = tuple(window_plan) if window_plan is not None else None
+        self._windowed = window > 1 or self._plan is not None
         # consumption is tracked here, not via loader.step: the worker may
-        # have drawn batches that no one has consumed yet
+        # have drawn batches that no one has consumed yet. In window mode
+        # _consumed counts loader STEPS (batches), _consumed_items counts
+        # windows (the plan cursor for a restarted worker generation).
         self._start_step = loader.step
         self._consumed = 0
+        self._consumed_items = 0
+
+    def _item_sizes(self, from_item: int) -> tp.Iterator[int]:
+        """Window sizes the worker should produce, starting at item index
+        ``from_item``: the remaining plan suffix, or an unbounded stream
+        of ``window``-sized items when no plan was given."""
+        if self._plan is not None:
+            yield from self._plan[from_item:]
+            return
+        while True:
+            yield self._window
 
     def _worker(
-        self, stop: threading.Event, q: "queue.Queue", begin_step: int
+        self, stop: threading.Event, q: "queue.Queue", begin_step: int,
+        from_item: int,
     ) -> None:
         # draws via the PURE loader.peek with a generation-local counter —
         # the shared Loader is never mutated, so a join-timeout zombie
         # cannot corrupt another generation's (or a resume's) data order
         produced = 0
-        while not stop.is_set():
+        for w in self._item_sizes(from_item):
+            if stop.is_set():
+                return
             try:
-                batch = self._transform(
-                    *self.loader.peek(begin_step + produced)
-                )
-                produced += 1
+                if self._windowed:
+                    draws = [
+                        self.loader.peek(begin_step + produced + i)
+                        for i in range(w)
+                    ]
+                    batch = self._transform(
+                        *(np.stack(col) for col in zip(*draws))
+                    )
+                else:
+                    batch = self._transform(
+                        *self.loader.peek(begin_step + produced)
+                    )
+                produced += w
+                item = (w, batch)
             except BaseException as exc:  # propagate to the consumer
-                batch = _PrefetchError(exc)
+                item = (w, _PrefetchError(exc))
             while not stop.is_set():
                 try:
-                    q.put(batch, timeout=0.1)
+                    q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     continue
-            if isinstance(batch, _PrefetchError):
+            if isinstance(item[1], _PrefetchError):
                 return
+        # finite plan exhausted (only a bounded _item_sizes ends the loop):
+        # publish a terminal sentinel so a consumer calling next() past the
+        # plan raises instead of blocking forever on an empty queue
+        sentinel = (0, _PlanExhausted())
+        while not stop.is_set():
+            try:
+                q.put(sentinel, timeout=0.1)
+                break
+            except queue.Full:
+                continue
 
     def start(self) -> "PrefetchLoader":
         if self._thread is None:
@@ -211,7 +270,10 @@ class PrefetchLoader:
             # the current one
             self._thread = threading.Thread(
                 target=self._worker,
-                args=(self._stop, self._queue, self._start_step + self._consumed),
+                args=(
+                    self._stop, self._queue,
+                    self._start_step + self._consumed, self._consumed_items,
+                ),
                 daemon=True,
             )
             self._thread.start()
@@ -220,11 +282,19 @@ class PrefetchLoader:
     def next(self):
         if self._thread is None:
             self.start()
-        batch = self._queue.get()
+        w, batch = self._queue.get()
         if isinstance(batch, _PrefetchError):
             self.stop()
             raise batch.exc
-        self._consumed += 1
+        if isinstance(batch, _PlanExhausted):
+            self.stop()
+            raise RuntimeError(
+                f"PrefetchLoader window_plan exhausted after "
+                f"{self._consumed_items} windows ({self._consumed} batches): "
+                "next() was called more times than the plan has items"
+            )
+        self._consumed += w
+        self._consumed_items += 1
         return batch
 
     def stop(self) -> None:
@@ -253,10 +323,15 @@ class PrefetchLoader:
         }
 
     def load_state_dict(self, state: tp.Mapping[str, int]) -> None:
+        # note for window mode: a restored step generally needs a NEW
+        # window plan (the trainer recomputes it from the restored step and
+        # constructs a fresh PrefetchLoader); loading here restarts any
+        # existing plan from its first entry
         self.stop()
         self.loader.load_state_dict(state)
         self._start_step = self.loader.step
         self._consumed = 0
+        self._consumed_items = 0
 
 
 def write_tokens(path: str, tokens: np.ndarray) -> None:

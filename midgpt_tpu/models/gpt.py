@@ -576,7 +576,8 @@ class MoEMLP:
 
     - routing/dispatch as DENSE one-hot einsums with STATIC shapes — the
       canonical TPU MoE formulation (no sorts, no ragged gathers, every
-      FLOP on the MXU); capacity is per batch row: C = ceil(cf * T / E).
+      FLOP on the MXU); capacity is per batch row and scales with top_k
+      (K claims per token share the buffers): C = ceil(cf * top_k * T / E).
     - experts stacked [E, D, F]/[E, F, D] and sharded over the 'tensor'
       mesh axis (GPT_PARAM_RULES): each shard computes its local experts'
       [B, E/tp, C, *] blocks and GSPMD inserts the psum on the combine
@@ -588,7 +589,14 @@ class MoEMLP:
 
     Tokens overflowing an expert's capacity are dropped (contribute zero;
     the residual connection passes them through) — standard Switch
-    semantics. Router runs in f32 for a stable softmax."""
+    semantics. At top_k > 1 capacity slots fill in TOKEN order with first
+    and second choices interleaved (one cumsum over the combined
+    assignment matrix) — a deliberate deviation from GShard, which fills
+    every first choice before admitting any second choice. The single
+    cumsum keeps the fill one static-shaped pass; the difference only
+    shows under overflow, where GShard would evict a late token's FIRST
+    choice in favor of an early token's second choice slightly less
+    often. Router runs in f32 for a stable softmax."""
 
     router: Linear  # [D, E]
     expert_up: Array  # [E, D, F]
@@ -683,7 +691,11 @@ class MoEMLP:
 
             # position of each (token, expert) claim within the expert's
             # capacity buffer — columns are independent, so one cumsum
-            # covers any K
+            # covers any K. NOTE: this fills slots in token order with
+            # 1st/2nd choices interleaved, NOT GShard's
+            # first-choices-first order (see the class docstring) — a
+            # deliberate trade of fill-priority fidelity for a single
+            # static-shaped pass
             pos = jnp.cumsum(assign, axis=1) * assign  # [B, T, E], 1-based
             pos = shard_act(pos, "batch", "seq", None)
             keep = (assign * (pos <= cap)).astype(x.dtype)  # [B, T, E]
@@ -870,9 +882,12 @@ class GPT:
         """[B, T, D] final (ln_f-normalized) hidden states; with
         ``return_kv`` also the per-layer post-rope K / raw V stacked
         [L, B, Hkv, T, C] (collected as scan ys — the prefill path).
-        ``return_aux`` additionally returns the mean per-layer MoE
-        load-balance loss (0.0 for dense MLPs) — the trainer consumes it
-        when cfg.mlp == "moe" (train.loss_fn)."""
+        ``return_aux`` additionally returns the MoE load-balance loss
+        SUMMED over layers (the scan carries ``aux_in + aux``; 0.0 for
+        dense MLPs) — the trainer consumes it when cfg.mlp == "moe"
+        (train.loss_fn scales the sum by ``moe_aux_weight``, so the
+        effective per-layer weight shrinks as 1/n_layer relative to a
+        mean; Switch's own formulation also sums over layers)."""
         cfg = self.config
         impl = attn_impl if attn_impl is not None else cfg.attn_impl
         b, t = tokens.shape

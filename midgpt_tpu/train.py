@@ -29,7 +29,11 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from midgpt_tpu.checkpoint import Checkpointer, config_fingerprint
-from midgpt_tpu.config import ExperimentConfig, to_dict
+from midgpt_tpu.config import (
+    ExperimentConfig,
+    resolve_dispatch_intervals,
+    to_dict,
+)
 from midgpt_tpu.data import Loader, PrefetchLoader, load_shard
 from midgpt_tpu.models.gpt import GPT, GPT_PARAM_RULES, count_params
 from midgpt_tpu.parallel.mesh import create_mesh
@@ -167,13 +171,22 @@ def _cfg_param_rules(cfg: ExperimentConfig):
     return gpt_param_rules(pipeline=cfg.mesh.pipeline > 1)
 
 
-def make_train_step(
+def _make_step_core(
     cfg: ExperimentConfig,
     tx: optax.GradientTransformation,
     mesh,
     param_rules=None,
 ):
-    """The jitted, donated train step (parity: train.py:79-97)."""
+    """The un-jitted single-step body shared by :func:`make_train_step`
+    (K=1, one dispatch per step) and :func:`make_train_window` (K steps
+    fused into one dispatch).
+
+    Returns ``step_fn(state, x, y, key) -> (new_state, aux)`` with
+    ``aux = {"loss", "grad_norm", "lr"}`` — per-step scalars cheap to
+    emit (the grad norm is CSE'd with the clip's internal computation,
+    the lr re-reads the schedule at ``state.step``). Callers that only
+    return the loss get the extras dead-code-eliminated, so the K=1
+    program is unchanged."""
     compute_dtype = _dtype(cfg.compute_dtype)
     param_dtype = _dtype(cfg.param_dtype)
     has_dropout = cfg.model.dropout > 0.0
@@ -181,6 +194,7 @@ def make_train_step(
     if param_rules is None:
         param_rules = _cfg_param_rules(cfg)
     pp_mesh = mesh if cfg.mesh.pipeline > 1 else None
+    schedule = make_lr_schedule(cfg)
 
     def step_fn(state: TrainState, x: Array, y: Array, key: Array):
         # x, y: [G, B, T]
@@ -228,6 +242,7 @@ def make_train_step(
         loss = loss_sum / g
         # average + promote to param dtype for the f32 optimizer update
         grads = jax.tree.map(lambda gr: (gr / g).astype(param_dtype), grads)
+        grad_norm = optax.global_norm(grads)  # CSE'd with clip_by_global_norm
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         # constrain the NEW opt state like params (the Adam moments are
         # param-shaped subtrees, so the same rule table resolves them;
@@ -239,18 +254,73 @@ def make_train_step(
         new_opt = constrain_params(new_opt, mesh, param_rules)
         new_params = optax.apply_updates(state.params, updates)
         new_params = constrain_params(new_params, mesh, param_rules)
+        aux = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "lr": schedule(state.step).astype(jnp.float32),
+        }
         return (
             TrainState(
                 params=new_params, opt_state=new_opt, step=state.step + 1
             ),
-            loss,
+            aux,
         )
+
+    return step_fn
+
+
+def make_train_step(
+    cfg: ExperimentConfig,
+    tx: optax.GradientTransformation,
+    mesh,
+    param_rules=None,
+):
+    """The jitted, donated train step (parity: train.py:79-97)."""
+    step_fn = _make_step_core(cfg, tx, mesh, param_rules)
 
     def wrapped(state, x, y, key):
         with axis_rules(mesh):
-            return step_fn(state, x, y, key)
+            new_state, aux = step_fn(state, x, y, key)
+        return new_state, aux["loss"]
 
     return jax.jit(wrapped, donate_argnums=(0,))
+
+
+def make_train_window(
+    cfg: ExperimentConfig,
+    tx: optax.GradientTransformation,
+    mesh,
+    k: int,
+    param_rules=None,
+):
+    """K full optimizer steps fused into ONE jitted, state-donating
+    ``lax.scan`` dispatch (cfg.steps_per_dispatch; PERF.md r5: a fixed
+    +25-50 ms/step per-dispatch latency on the relay amortizes K-fold).
+
+    Takes a device-resident window of K batches ``xs/ys [K, G, B, T]``
+    and the run's base PRNG key; each scanned step derives its key as
+    ``fold_in(key, state.step)`` — the same derivation the K=1 loop does
+    host-side with the loop index, so the per-step key stream (and hence
+    the loss sequence) is bit-identical to K=1. Per-step (loss, grad-norm,
+    lr) come back STACKED ``[K]`` as scan outputs: logging stays per-step
+    exact with zero extra host syncs (one device->host read per logging
+    window, not per step)."""
+    assert k >= 1, k
+    step_fn = _make_step_core(cfg, tx, mesh, param_rules)
+
+    def window_fn(state: TrainState, xs: Array, ys: Array, key: Array):
+        # xs, ys: [K, G, B, T]
+        with axis_rules(mesh):
+            def body(s, xy):
+                x, y = xy
+                step_key = jax.random.fold_in(key, s.step)
+                s2, aux = step_fn(s, x, y, step_key)
+                return s2, aux
+
+            state, stacked = jax.lax.scan(body, state, (xs, ys))
+        return state, stacked  # each aux leaf stacked to [K]
+
+    return jax.jit(window_fn, donate_argnums=(0,))
 
 
 def make_eval_step(cfg: ExperimentConfig, mesh):
@@ -467,6 +537,25 @@ def resolve_auto_knobs(cfg: ExperimentConfig, n_devices: int,
     return resolved
 
 
+def window_plan(first_step: int, max_steps: int, k: int) -> tp.List[int]:
+    """Per-dispatch window sizes covering steps [first_step, max_steps).
+
+    Windows align to the absolute K grid: a resume landing mid-grid (e.g.
+    a K=1 checkpoint resumed with K=4) gets a shorter FIRST window so every
+    later window start is a multiple of K — eval/ckpt intervals (validated
+    multiples of K) then always land on window boundaries. The final
+    window is shorter when max_steps is off-grid; steady state is
+    ceil(steps / K) dispatches."""
+    assert k >= 1, k
+    plan = []
+    s = first_step
+    while s < max_steps:
+        w = min(k - (s % k), max_steps - s)
+        plan.append(w)
+        s += w
+    return plan
+
+
 def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     """The orchestrator (parity: train.py:127-225). Returns final metrics.
 
@@ -478,6 +567,9 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
     import signal
 
     assert cfg.rundir, "rundir required"
+    # fail fast on eval/ckpt intervals misaligned with steps_per_dispatch
+    # (before any mesh/data/compile work)
+    cfg = resolve_dispatch_intervals(cfg)
     stop_requested = {"flag": False}
     prev_handler = None
 
@@ -528,7 +620,19 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         )
 
         tx, schedule = make_optimizer(cfg)
-        train_step = make_train_step(cfg, tx, mesh)
+        k_disp = cfg.steps_per_dispatch
+        # K=1 keeps today's one-dispatch-per-step path and jitted step
+        # object; K>1 runs fused windows built lazily per length (steady
+        # state compiles one K-step program; an off-grid first/last window
+        # compiles its own shorter one)
+        train_step = make_train_step(cfg, tx, mesh) if k_disp == 1 else None
+        _window_progs: tp.Dict[int, tp.Any] = {}
+
+        def _get_window_prog(kk: int):
+            if kk not in _window_progs:
+                _window_progs[kk] = make_train_window(cfg, tx, mesh, kk)
+            return _window_progs[kk]
+
         eval_step = make_eval_step(cfg, mesh)
 
         # resolve_auto_knobs' HBM-fit estimate is calibrated on one chip
@@ -541,9 +645,47 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # runtime OOM that already ate the state re-raises with the
         # original error).
         _first_step_done = {"done": not _remat_was_auto}
+        # window programs are compiled per LENGTH — each length's first
+        # dispatch gets its own ladder guard (a short off-grid first
+        # window succeeding must not disarm the guard for the bigger
+        # full-K program, whose deeper batch window is what OOMs)
+        _warm_window_lens: tp.Set[int] = set()
+
+        def _try_remat_step_down(e, state) -> bool:
+            """Shared OOM ladder for exec_step/exec_window: True after
+            stepping cfg one rung down the remat ladder, False when the
+            failure isn't a recoverable first-dispatch OOM (non-OOM error,
+            ladder exhausted, or the donated state is already consumed)."""
+            nonlocal cfg
+            nxt = {"none": "dots", "dots": "full"}.get(cfg.model.remat)
+            state_alive = not any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in (
+                    jax.tree.leaves(state.params)
+                    + jax.tree.leaves(state.opt_state)
+                )
+            )
+            if (
+                "RESOURCE_EXHAUSTED" not in str(e)
+                or nxt is None
+                or not state_alive
+            ):
+                return False
+            if proc == 0:
+                print(
+                    f"first-step OOM at remat={cfg.model.remat}; "
+                    f"retrying with remat={nxt}"
+                )
+            cfg = dataclasses.replace(
+                cfg,
+                model=dataclasses.replace(
+                    cfg.model, remat=nxt, scan_unroll=1
+                ),
+            )
+            return True
 
         def exec_step(state, xg, yg, k):
-            nonlocal train_step, cfg
+            nonlocal train_step
             if _first_step_done["done"]:
                 return train_step(state, xg, yg, k)
             while True:
@@ -552,33 +694,27 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     jax.block_until_ready(out)
                     _first_step_done["done"] = True
                     return out
-                except Exception as e:  # noqa: BLE001 — filtered below
-                    nxt = {"none": "dots", "dots": "full"}.get(cfg.model.remat)
-                    state_alive = not any(
-                        getattr(a, "is_deleted", lambda: False)()
-                        for a in (
-                            jax.tree.leaves(state.params)
-                            + jax.tree.leaves(state.opt_state)
-                        )
-                    )
-                    if (
-                        "RESOURCE_EXHAUSTED" not in str(e)
-                        or nxt is None
-                        or not state_alive
-                    ):
+                except Exception as e:  # noqa: BLE001 — filtered in helper
+                    if not _try_remat_step_down(e, state):
                         raise
-                    if proc == 0:
-                        print(
-                            f"first-step OOM at remat={cfg.model.remat}; "
-                            f"retrying with remat={nxt}"
-                        )
-                    cfg = dataclasses.replace(
-                        cfg,
-                        model=dataclasses.replace(
-                            cfg.model, remat=nxt, scan_unroll=1
-                        ),
-                    )
                     train_step = make_train_step(cfg, tx, mesh)
+
+        def exec_window(kk, state, xs, ys, k):
+            if not _remat_was_auto or kk in _warm_window_lens:
+                return _get_window_prog(kk)(state, xs, ys, k)
+            while True:
+                try:
+                    out = _get_window_prog(kk)(state, xs, ys, k)
+                    jax.block_until_ready(out)
+                    _warm_window_lens.add(kk)
+                    return out
+                except Exception as e:  # noqa: BLE001 — filtered in helper
+                    if not _try_remat_step_down(e, state):
+                        raise
+                    # rebuilt lazily at the stepped-down remat; previously
+                    # warm lengths re-guard too (their programs changed)
+                    _window_progs.clear()
+                    _warm_window_lens.clear()
 
         ckpt = Checkpointer(
             cfg.rundir,
@@ -672,28 +808,183 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # next batch is gathered + device_put on a background thread while the
         # current step runs (the reference pays this on the critical path,
         # train.py:203-207)
-        prefetch = PrefetchLoader(
-            train_loader,
-            transform=lambda x, y: (
-                make_global_array(x, mesh, batch_spec),
-                make_global_array(y, mesh, batch_spec),
-            ),
-        ).start()
+        if k_disp > 1:
+            # window mode: the prefetch thread stacks each dispatch's K
+            # batches into one [K, G, B, T] global array (leading window
+            # axis unsharded) — a K-deep batch window resident in HBM
+            plan = window_plan(first_step, cfg.max_steps, k_disp)
+            window_spec = P(None, *batch_spec)
+            prefetch = PrefetchLoader(
+                train_loader,
+                transform=lambda x, y: (
+                    make_global_array(x, mesh, window_spec),
+                    make_global_array(y, mesh, window_spec),
+                ),
+                window=k_disp,
+                window_plan=plan,
+            ).start()
+        else:
+            prefetch = PrefetchLoader(
+                train_loader,
+                transform=lambda x, y: (
+                    make_global_array(x, mesh, batch_spec),
+                    make_global_array(y, mesh, batch_spec),
+                ),
+            ).start()
         tokens_per_step = cfg.batch_size * t
         last_log_time, last_log_step = time.time(), first_step
         final: tp.Dict[str, float] = {}
 
-        try:
-            from tqdm import tqdm
+        dispatch_count = 0
+        ckpt_every = (
+            cfg.ckpt_interval
+            if cfg.ckpt_interval is not None
+            else cfg.eval_interval
+        )
 
-            pbar = tqdm(
-                range(first_step, cfg.max_steps),
-                initial=first_step,
-                total=cfg.max_steps,
-                disable=proc != 0,
-            )
-        except ImportError:  # pragma: no cover
-            pbar = range(first_step, cfg.max_steps)
+        def _run_window_loop(state):
+            """steps_per_dispatch > 1: one fused K-step dispatch per
+            window. Interval handling happens at window granularity —
+            window boundaries are exact optimizer-step boundaries, and
+            eval/ckpt intervals were validated as multiples of K, so the
+            eval/ckpt cadence lands exactly where the K=1 loop puts it."""
+            nonlocal dispatch_count, last_log_time, last_log_step
+            try:
+                from tqdm import tqdm
+
+                wbar = tqdm(
+                    total=cfg.max_steps, initial=first_step,
+                    disable=proc != 0,
+                )
+            except ImportError:  # pragma: no cover
+                wbar = None
+            w_start = first_step
+            for wi, k_eff in enumerate(plan):
+                if w_start % cfg.eval_interval == 0 or w_start == first_step:
+                    n_eval = 1 if cfg.debug else cfg.eval_batches
+                    eoff = 0 if cfg.eval_fixed else w_start
+                    train_loss = evaluate(
+                        eval_step, state.params, train_eval_loader, mesh,
+                        n_eval, eoff,
+                    )
+                    val_loss = evaluate(
+                        eval_step, state.params, val_loader, mesh, n_eval,
+                        eoff,
+                    )
+                    logger.log(
+                        w_start,
+                        {"loss/train": train_loss, "loss/val": val_loss},
+                    )
+                    final.update(
+                        {"train_loss": train_loss, "val_loss": val_loss}
+                    )
+
+                xs, ys = prefetch.next()  # [k_eff, G, B, T] global arrays
+                if (
+                    cfg.debug and wi == 1
+                    and not cfg.rundir.startswith("gs://")
+                ):
+                    # profile exactly one post-warmup window
+                    with jax.profiler.trace(
+                        os.path.join(cfg.rundir, "profile")
+                    ):
+                        state, wout = exec_window(k_eff, state, xs, ys, key)
+                        jax.block_until_ready(wout["loss"])
+                else:
+                    state, wout = exec_window(k_eff, state, xs, ys, key)
+                dispatch_count += 1
+                w_end = w_start + k_eff - 1
+                if wbar is not None:
+                    wbar.update(k_eff)
+
+                log_steps = [
+                    s
+                    for s in range(w_start, w_start + k_eff)
+                    if s % cfg.log_interval == 0 and s > 0
+                ]
+                if log_steps:
+                    # per-step (loss, grad-norm, lr) come out of the scan
+                    # STACKED; they cross to the host once per logging
+                    # window — no added syncs vs the K=1 loop
+                    losses_h = np.asarray(wout["loss"])
+                    lrs_h = np.asarray(wout["lr"])
+                    gnorms_h = np.asarray(wout["grad_norm"])
+                    now = time.time()
+                    for s in log_steps:
+                        i = s - w_start
+                        loss_v = float(losses_h[i])
+                        metrics = {
+                            "loss/optimized": loss_v,
+                            "lr": float(lrs_h[i]),
+                            "grad_norm": float(gnorms_h[i]),
+                        }
+                        if s == log_steps[-1]:
+                            # throughput is host-clocked: it exists at
+                            # window, not step, granularity
+                            tps = (
+                                tokens_per_step
+                                * (s - last_log_step)
+                                / max(now - last_log_time, 1e-9)
+                            )
+                            last_log_time, last_log_step = now, s
+                            metrics["tokens_per_sec"] = tps
+                            metrics["mfu"] = mfu(
+                                tps, cfg.model, jax.device_count()
+                            )
+                            final["tokens_per_sec"] = tps
+                            final["mfu"] = metrics["mfu"]
+                        logger.log(s, metrics)
+                        final["loss"] = loss_v
+                    if wbar is not None and hasattr(wbar, "set_postfix"):
+                        wbar.set_postfix(loss=f"{final['loss']:.3f}")
+
+                if not cfg.debug and (
+                    (wi == 0 and first_step == 0)
+                    or (w_end + 1) % ckpt_every == 0
+                    or stop_requested["flag"]
+                ):
+                    # window ends sit on the K grid, never on orbax's
+                    # step % interval == 0 grid — interval saves are gated
+                    # here (ckpt_every is a validated multiple of K) and
+                    # forced through the manager. A SIGTERM force-save
+                    # lands on the completed window: an exact step
+                    # boundary, so resume replays nothing partially.
+                    ckpt.save(
+                        w_end,
+                        _ckpt_items(state),
+                        meta={
+                            "step": w_end,
+                            "loader": prefetch.state_dict(),
+                            "model_fingerprint": fingerprint,
+                            "config": to_dict(cfg),
+                        },
+                        force=True,
+                    )
+                if stop_requested["flag"]:
+                    if proc == 0:
+                        print(f"SIGTERM: checkpointed step {w_end}, exiting")
+                    final["interrupted_at"] = w_end
+                    break
+                w_start += k_eff
+            if wbar is not None:
+                wbar.close()
+            return state
+
+        if k_disp > 1:
+            state = _run_window_loop(state)
+            pbar = ()  # the per-step loop below is the K=1 path
+        else:
+            try:
+                from tqdm import tqdm
+
+                pbar = tqdm(
+                    range(first_step, cfg.max_steps),
+                    initial=first_step,
+                    total=cfg.max_steps,
+                    disable=proc != 0,
+                )
+            except ImportError:  # pragma: no cover
+                pbar = range(first_step, cfg.max_steps)
 
         loss = None
         for itr in pbar:
@@ -720,6 +1011,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     jax.block_until_ready(loss)
             else:
                 state, loss = exec_step(state, xg, yg, step_key)
+            dispatch_count += 1
 
             if itr % cfg.log_interval == 0 and itr > 0:
                 loss_v = float(loss)
@@ -766,6 +1058,9 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 break
 
         prefetch.stop()
+        # steady-state launch count: ceil(steps / K) fused dispatches
+        # (tested by tests/test_train_window.py)
+        final["train_dispatches"] = dispatch_count
         if "interrupted_at" in final:
             # preempted: the in-loop force-save owns the last completed step;
             # a max_steps-1 save here would mislabel partial progress

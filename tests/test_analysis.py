@@ -38,6 +38,7 @@ from midgpt_tpu.analysis.rules import (
     NoBatchAllGather,
     NoF64,
     NoFullSequenceGather,
+    NoHostSync,
 )
 from midgpt_tpu.config import MeshConfig, get_config
 
@@ -254,8 +255,38 @@ def test_ruleset_report_shape():
     assert report.ok
     d = report.to_dict()
     assert d["ok"] and {r["rule"] for r in d["rules"]} == {
-        "no-f64", "no-batch-allgather", "donation-intact",
+        "no-f64", "no-batch-allgather", "donation-intact", "no-host-sync",
     }
+
+
+def test_no_host_sync_passes_on_good():
+    assert NoHostSync().check(_analysis("good_fsdp.hlo")) == []
+
+
+def test_no_host_sync_fires_on_callback_and_feeds():
+    """pure_callback/io_callback custom-calls, infeed/outfeed, and
+    host-transfer send/recv are host round-trips; device-to-device
+    send/recv and ordinary custom-calls (e.g. oneDNN matmul) are not."""
+    hlo = (
+        "ENTRY %main {\n"
+        "  %custom-call.5 = (f32[4]{0}) custom-call(s64[] %c, f32[4]{0} %p),"
+        ' custom_call_target="xla_python_cpu_callback"\n'
+        "  %custom-call.9 = f32[8,8]{1,0} custom-call(f32[8,8]{1,0} %a),"
+        ' custom_call_target="__onednn$matmul"\n'
+        "  %infeed.1 = ((f32[4]{0}), token[]) infeed(token[] %tok)\n"
+        "  %send.2 = (f32[4]{0}, u32[], token[]) send(f32[4]{0} %p, "
+        "token[] %tok), channel_id=3, is_host_transfer=true\n"
+        "  %send.3 = (f32[4]{0}, u32[], token[]) send(f32[4]{0} %p, "
+        "token[] %tok), channel_id=4\n"
+        "}\n"
+    )
+    a = StepAnalysis.from_text(hlo, MESH, global_batch=B, block=T)
+    vs = NoHostSync().check(a)
+    msgs = " | ".join(v.message for v in vs)
+    assert len(vs) == 3, vs
+    assert "python-callback" in msgs
+    assert "infeed" in msgs
+    assert "host-transfer send" in msgs
 
 
 def test_rules_for_config_selects_by_parallelism():

@@ -133,6 +133,101 @@ def test_prefetch_loader_matches_sync(token_file):
         pre.stop()
 
 
+def test_prefetch_window_stacks_consecutive_batches(token_file):
+    """Window mode: each next() is K consecutive loader batches stacked
+    along a new leading axis — the [K, ...] window the fused multi-step
+    dispatch consumes."""
+    from midgpt_tpu.data import PrefetchLoader
+
+    shard = load_shard(token_file)
+    sync = Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9)
+    expected = [sync.next() for _ in range(6)]
+
+    pre = PrefetchLoader(
+        Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9),
+        window=3,
+    )
+    try:
+        for w in range(2):
+            x, y = pre.next()
+            assert x.shape == (3, 2, 16)
+            for i in range(3):
+                np.testing.assert_array_equal(x[i], expected[3 * w + i][0])
+                np.testing.assert_array_equal(y[i], expected[3 * w + i][1])
+    finally:
+        pre.stop()
+
+
+def test_prefetch_window_plan_partial_first_and_last(token_file):
+    """An explicit window_plan (the trainer's dispatch plan after an
+    off-grid resume) yields per-item stacks of the planned sizes, then the
+    worker stops — no draws past the plan."""
+    from midgpt_tpu.data import PrefetchLoader
+
+    shard = load_shard(token_file)
+    sync = Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9)
+    expected = [sync.next() for _ in range(6)]
+
+    pre = PrefetchLoader(
+        Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9),
+        window=3, window_plan=[2, 3, 1],
+    ).start()
+    try:
+        seen = 0
+        for w in [2, 3, 1]:
+            x, _ = pre.next()
+            assert x.shape == (w, 2, 16)
+            for i in range(w):
+                np.testing.assert_array_equal(x[i], expected[seen + i][0])
+            seen += w
+        assert pre.state_dict()["step"] == 6
+        # past the plan: the worker published a terminal sentinel — one
+        # more next() must RAISE, not block forever on an empty queue
+        with pytest.raises(RuntimeError, match="window_plan exhausted"):
+            pre.next()
+    finally:
+        pre.stop()
+
+
+def test_prefetch_window_state_replays_unconsumed_mid_window(token_file):
+    """Stop/resume mid-window (depth-aware): batches drawn into queued-but-
+    unconsumed windows must NOT count as consumed — a resume from
+    state_dict() replays every batch of every unconsumed window exactly
+    (extends the generation-zombie tests above to window mode)."""
+    import time
+
+    from midgpt_tpu.data import PrefetchLoader
+
+    shard = load_shard(token_file)
+    pre = PrefetchLoader(
+        Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9),
+        depth=3, window=2,
+    ).start()
+    try:
+        consumed = [pre.next() for _ in range(2)]  # 2 windows = 4 batches
+        time.sleep(0.2)  # let the worker queue more windows
+        state = pre.state_dict()
+        # only the consumed windows' batches count (2 windows x 2)
+        assert state["step"] == 4
+    finally:
+        pre.stop()
+
+    sync = Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9)
+    expected = [sync.next() for _ in range(6)]
+    resumed = PrefetchLoader(
+        Loader(shard=shard, block_size=16, batch_shape=(2,), seed=9),
+        window=2,
+    )
+    resumed.load_state_dict(state)
+    try:
+        x, _ = resumed.next()  # replays batches 4 and 5 exactly
+        np.testing.assert_array_equal(x[0], expected[4][0])
+        np.testing.assert_array_equal(x[1], expected[5][0])
+    finally:
+        resumed.stop()
+    del consumed
+
+
 def test_prefetch_loader_state_excludes_unconsumed(token_file):
     """Checkpointed loader state must count only consumed batches, not ones
     sitting in the prefetch queue."""
