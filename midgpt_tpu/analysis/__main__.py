@@ -88,6 +88,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the AST TPU-footgun lint instead of the HLO audit "
         "(default path: the midgpt_tpu package)",
     )
+    p.add_argument(
+        "--serving", action="store_true",
+        help="audit the serving engine's fused K-step DECODE window "
+        "(midgpt_tpu.serving) instead of the train step: donation must "
+        "stay intact across the window (KV pool + logits alias "
+        "input->output) and no host sync may hide inside it; "
+        "--steps-per-dispatch sets K (default 4)",
+    )
+    p.add_argument(
+        "--serving-slots", type=int, default=4, metavar="S",
+        help="decode slots for the serving audit (default 4)",
+    )
+    p.add_argument(
+        "--serving-page-size", type=int, default=16, metavar="P",
+        help="KV page size for the serving audit (default 16)",
+    )
     return p
 
 
@@ -169,6 +185,43 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         cfg = dataclasses.replace(
             cfg, steps_per_dispatch=args.steps_per_dispatch
         )
+
+    if args.serving:
+        from midgpt_tpu.analysis.harness import audit_decode_window
+
+        k = args.steps_per_dispatch or 4
+        analysis, report = audit_decode_window(
+            cfg,
+            slots=args.serving_slots,
+            window=k,
+            page_size=args.serving_page_size,
+            shrink=not args.no_shrink,
+        )
+        out = {
+            "config": args.config,
+            "mode": "serving-decode-window",
+            "ok": report.ok,
+            "geometry": {
+                "slots": args.serving_slots,
+                "steps_per_dispatch": k,
+                "page_size": args.serving_page_size,
+                "donated_leaves": analysis.donated_leaves,
+                "aliased_buffers": len(
+                    {e.param_number for e in analysis.aliases}
+                ),
+            },
+            "rules": report.to_dict()["rules"],
+        }
+        text = json.dumps(out, indent=2)
+        print(text)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        if not report.ok:
+            for v in report.violations:
+                print(f"VIOLATION {v}", file=sys.stderr)
+            return 1
+        return 0
 
     overrides = dict(args.override_logical_rule) or None
     if overrides:

@@ -193,6 +193,110 @@ def audit_config(
     return analysis, report, cost_report(analysis)
 
 
+def compile_decode_window(
+    cfg: ExperimentConfig,
+    *,
+    slots: int = 4,
+    window: int = 4,
+    page_size: int = 16,
+    shrink: bool = True,
+):
+    """Compile the serving engine's fused K-step decode window
+    (``midgpt_tpu.serving.make_decode_window``) for ``cfg``'s model —
+    the program the engine launches once per K generated tokens. Returns
+    ``(hlo_text, mesh, donated_leaves, audited_block_size)`` — the block
+    size is the AUDITED model's (shrunk when ``shrink``), which is the
+    geometry the HLO was actually compiled at.
+
+    Audited for the same two regressions the K-step train window is:
+    donation staying intact across the window (pool + logits buffers must
+    alias input->output, or every window holds two copies of the KV pool
+    in HBM) and no host sync hiding inside it (one stray callback stalls
+    all K decode steps per launch)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np_
+
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.serving.engine import make_decode_window
+    from midgpt_tpu.serving.paged import PagedKVPool, pages_needed
+
+    model_cfg = cfg.model
+    if shrink:
+        model_cfg = _dc.replace(
+            model_cfg, n_layer=2, block_size=256, vocab_size=1024,
+            remat="none", scan_unroll=1,
+        )
+    mesh = create_mesh(
+        MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1),
+        devices=jax.devices()[:1],
+    )
+    model = GPT.init(jax.random.PRNGKey(0), model_cfg)
+    from midgpt_tpu.pytree import cast_floating
+
+    model = cast_floating(model, jnp.bfloat16)
+    pmax = pages_needed(model_cfg.block_size, page_size)
+    num_pages = slots * pmax
+    window_fn = make_decode_window(
+        model, slots=slots, window=window, pmax=pmax,
+        rope_len=model_cfg.block_size,
+    )
+    pool = PagedKVPool.init(model_cfg, num_pages, page_size)
+    logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
+    i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
+    hlo = window_fn.lower(
+        pool, logits, i32(slots, pmax), i32(slots),
+        np_.zeros((slots,), bool), i32(slots), i32(slots), i32(slots),
+        i32(slots), jax.random.PRNGKey(1),
+    ).compile().as_text()
+    donated_leaves = len(jax.tree.leaves((pool, logits)))
+    # return the AUDITED model's block size: with shrink it differs from
+    # cfg's, and geometry-dependent rules must see the compiled program's
+    return hlo, mesh, donated_leaves, model_cfg.block_size
+
+
+def audit_decode_window(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    slots: int = 4,
+    window: int = 4,
+    page_size: int = 16,
+    shrink: bool = True,
+) -> tp.Tuple[StepAnalysis, Report]:
+    """One-call serving audit: compile the fused decode window and check
+    the serving invariants (donation-intact, no-host-sync, no-f64)."""
+    from midgpt_tpu.analysis.rules import (
+        DonationIntact,
+        NoF64,
+        NoHostSync,
+        RuleSet,
+    )
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    hlo, mesh, donated, block = compile_decode_window(
+        cfg, slots=slots, window=window, page_size=page_size, shrink=shrink
+    )
+    analysis = StepAnalysis.from_text(
+        hlo,
+        hlo_mod.MeshInfo.from_mesh(mesh, num_slices=1),
+        global_batch=slots,
+        block=block,
+        donated_leaves=donated,
+    )
+    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
+        analysis
+    )
+    return analysis, report
+
+
 def train_step_comms_summary(cfg: ExperimentConfig) -> tp.Dict[str, tp.Any]:
     """Flat scalar comms summary for an already-benchmarked config —
     bench.py attaches this to its one-JSON-line record. Compiles the

@@ -405,6 +405,83 @@ class Attention:
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
         return self.wo(out), cache_k, cache_v
 
+    def decode_paged_at(
+        self,
+        x: Array,  # [S, 1, D] — one new token per decode SLOT
+        pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+        pool_v: Array,  # [L, NP, Hkv, C, PS]
+        bt: Array,  # [S, Pmax] int32 per-slot block tables (page ids)
+        rk: Array,  # [L, S, Hkv, R, C] recent-K write buffer (row writes)
+        rv: Array,  # [L, S, Hkv, R, C]
+        layer: int,  # STATIC layer index
+        r: Array,  # [] int32 — step index within the decode window
+        mask_pool: Array,  # [S, W=Pmax*PS] additive f32 over paged slots
+        mask_rec: Array,  # [R] additive f32 over recent rows
+        sin_rows: Array,  # [S, 1, 1, C//2] per-slot rope rows (positions differ)
+        cos_rows: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Single-token attention against a PAGED KV pool read through
+        per-slot block tables, plus the write-combining recent buffer.
+
+        The serving variant of :meth:`decode_recent_at`: instead of one
+        contiguous per-batch ring cache, every slot (request) owns a list
+        of fixed-size pages in a shared pool (``midgpt_tpu.serving``) —
+        its logical KV is the concatenation of its block-table pages. The
+        gather through ``bt`` is the only new op; the two-part joint
+        softmax (exact, not an approximation) and the read-only-pool /
+        bulk-merge write discipline are identical to the chunked sampler's
+        (PERF.md r4 'Serving': per-token scattered column writes into the
+        big time-minor cache either flip its layout or pay scattered RMW).
+        Positions differ PER SLOT (continuous batching mixes requests at
+        different depths), hence per-slot rope rows and a [S, W] mask."""
+        b, one, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        q, k, v = self._decode_qkv(x, sin_rows, cos_rows)
+        zero = jnp.zeros((), r.dtype)
+        at = (jnp.asarray(layer, r.dtype), zero, zero, r, zero)
+        rk = jax.lax.dynamic_update_slice(rk, k.astype(rk.dtype)[None], at)
+        rv = jax.lax.dynamic_update_slice(rv, v.astype(rv.dtype)[None], at)
+        # gather this layer's pages through the block tables: the slot's
+        # logical KV [S, Hkv, C, W] in page order. mode="clip", NOT the
+        # default "fill": block-table pads carry the out-of-range sentinel,
+        # and fill-mode NaNs would poison the score sum straight through
+        # the additive mask (0 * NaN = NaN); clipped garbage is erased by
+        # mask_pool's -inf before the softmax.
+        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+        s_, pmax, _, _, ps = pk_l.shape
+        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        rkl, rvl = rk[layer], rv[layer]  # [S, Hkv, R, C]
+        qg = q.reshape(b, hkv, h // hkv, 1, c)
+        qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [S, Hkv, G, C, 1]
+        s_pool = jnp.sum(
+            qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
+            axis=-2,
+        )  # [S, Hkv, G, W]
+        s_rec = jnp.sum(
+            qg.astype(jnp.float32) * rkl[:, :, None].astype(jnp.float32),
+            axis=-1,
+        )  # [S, Hkv, G, R]
+        s_all = jnp.concatenate(
+            [s_pool + mask_pool[:, None, None, :], s_rec + mask_rec], axis=-1
+        )
+        probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)
+        p_pool = probs[..., : s_pool.shape[-1]]
+        p_rec = probs[..., s_pool.shape[-1]:]
+        o_pool = jnp.sum(
+            p_pool[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
+            axis=-1,
+        )  # [S, Hkv, G, C]
+        o_rec = jnp.sum(
+            p_rec[..., None] * rvl[:, :, None].astype(jnp.float32), axis=-2
+        )
+        out = (o_pool + o_rec).astype(x.dtype)
+        out = out.reshape(b, h, 1, c)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
+        return self.wo(out), rk, rv
+
     def decode_recent_at(
         self,
         x: Array,  # [B, 1, D]
@@ -637,7 +714,14 @@ class MoEMLP:
         *,
         key: tp.Optional[KeyArray] = None,
         deterministic: bool = True,
-    ) -> tp.Tuple[Array, Array]:
+        return_dropped: bool = False,
+    ) -> tp.Tuple[Array, ...]:
+        """(y, aux) — with ``return_dropped`` also the dropped-claim
+        fraction: routing claims past their expert's capacity contribute
+        zero output (standard Switch drop semantics), and that fraction
+        is the one silent failure mode of the subsystem — a collapsed
+        router looks fine in the loss curve while most tokens pass
+        through the residual untouched (VERDICT r5 Next #7)."""
         b, t, d = x.shape
         e = self.n_experts
         # GShard capacity: K claims per token share the buffers, so C
@@ -724,7 +808,15 @@ class MoEMLP:
             comb = disp * w.astype(x.dtype)[..., None]
             y = jnp.einsum("btec,becd->btd", comb, ye)
             y = dropout(y, self.dropout_rate, key, deterministic)
-            return shard_act(y, "batch", "seq", "embed"), aux
+            y = shard_act(y, "batch", "seq", "embed")
+            if not return_dropped:
+                return y, aux
+            # fraction of routing claims past capacity (dropped): scalar
+            # reductions partition cleanly under any mesh
+            n_claims = jnp.sum(assign.astype(jnp.float32))
+            n_kept = jnp.sum(keep.astype(jnp.float32))
+            dropped = 1.0 - n_kept / jnp.maximum(n_claims, 1.0)
+            return y, aux, dropped
 
 
 def make_mlp(key: KeyArray, cfg: ModelConfig):
@@ -734,8 +826,17 @@ def make_mlp(key: KeyArray, cfg: ModelConfig):
     return MLP.init(key, cfg)
 
 
-def mlp_call(mlp, x, *, key=None, deterministic=True):
-    """(y, aux) for either MLP kind — dense returns aux = 0."""
+def mlp_call(mlp, x, *, key=None, deterministic=True, with_stats=False):
+    """(y, aux) for either MLP kind — dense returns aux = 0. With
+    ``with_stats``: (y, aux, dropped_frac), dense dropped = 0."""
+    if with_stats:
+        if isinstance(mlp, MoEMLP):
+            return mlp(
+                x, key=key, deterministic=deterministic, return_dropped=True
+            )
+        y = mlp(x, key=key, deterministic=deterministic)
+        zero = jnp.zeros((), jnp.float32)
+        return y, zero, zero
     out = mlp(x, key=key, deterministic=deterministic)
     if isinstance(mlp, MoEMLP):
         return out
@@ -808,6 +909,18 @@ class Block:
         attn_out, rk, rv = self.attn.decode_recent_at(
             self.ln1(x), cache_k, cache_v, rk, rv, layer, r,
             mask_big, mask_rec, sin_row, cos_row,
+        )
+        x = x + attn_out
+        x = x + mlp_call(self.mlp, self.ln2(x))[0]
+        return x, rk, rv
+
+    def decode_paged_at(
+        self, x, pool_k, pool_v, bt, rk, rv, layer, r, mask_pool, mask_rec,
+        sin_rows, cos_rows,
+    ):
+        attn_out, rk, rv = self.attn.decode_paged_at(
+            self.ln1(x), pool_k, pool_v, bt, rk, rv, layer, r,
+            mask_pool, mask_rec, sin_rows, cos_rows,
         )
         x = x + attn_out
         x = x + mlp_call(self.mlp, self.ln2(x))[0]
@@ -958,6 +1071,42 @@ class GPT:
                 return ((h, kvs), aux) if return_kv else (h, aux)
             return (h, kvs) if return_kv else h
 
+    def moe_stats(
+        self, tokens: Array, *, attn_impl: tp.Optional[str] = None
+    ) -> tp.Dict[str, Array]:
+        """Router telemetry from one deterministic forward: the MoE
+        load-balance aux (summed over layers, the training convention)
+        and the dropped-claim fraction (mean over layers). Runs its own
+        layer scan so the hot ``hidden`` path carries no stats plumbing;
+        the trainer calls this once per eval interval (utils.metrics logs
+        the two scalars) — a collapsed or overflowing router becomes
+        visible the interval it happens instead of never."""
+        cfg = self.config
+        assert cfg.mlp == "moe", "moe_stats requires an MoE model"
+        impl = attn_impl if attn_impl is not None else cfg.attn_impl
+        b, t = tokens.shape
+        sin, cos = rope_tables(cfg.head_dim, t, cfg.rope_base)
+
+        with jax.named_scope("moe_stats"):
+            h = embed_tokens(self.wte, tokens)
+            h = shard_act(h, "batch", "seq", "embed")
+
+            def body(hc, block):
+                attn_out = block.attn(
+                    block.ln1(hc), sin, cos, impl=impl, deterministic=True
+                )
+                hc = hc + attn_out
+                y, aux, dropped = mlp_call(
+                    block.mlp, block.ln2(hc), with_stats=True
+                )
+                return hc + y, (aux, dropped)
+
+            _, (auxs, droppeds) = jax.lax.scan(body, h, self.blocks)
+        return {
+            "aux": jnp.sum(auxs),
+            "dropped_frac": jnp.mean(droppeds),
+        }
+
     def head_weight(self, dtype) -> Array:
         """[D, V] lm-head weight in ``dtype`` (the shared wte array when
         init-only-tied/tied, SURVEY.md 2.3)."""
@@ -1107,6 +1256,65 @@ def decode_step_recent(
         )
     h = model.ln_f(h)
     logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
+    return logits, rk, rv
+
+
+def decode_step_paged(
+    model: GPT,
+    tokens: Array,  # [S] int32 — the newest token per decode slot
+    pos: Array,  # [S] int32 — PER-SLOT absolute position of this token
+    pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+    pool_v: Array,  # [L, NP, Hkv, C, PS]
+    bt: Array,  # [S, Pmax] int32 per-slot block tables
+    rk: Array,  # [L, S, Hkv, R, C] recent buffers
+    rv: Array,
+    r: Array,  # [] int32 — step index within the decode window
+    pooled_len: Array,  # [S] int32 — tokens already flushed to the pool
+    rope_len: int,
+) -> tp.Tuple[Array, Array, Array]:
+    """One decode step of the continuous-batching engine: every slot
+    attends over its OWN block-table pages (positions < pooled_len[s])
+    plus the shared recent buffer (window positions pooled_len[s]..r),
+    and appends its token's K/V to the recent buffer. The pool is never
+    written here — ``midgpt_tpu.serving.flush_recent`` folds the window's
+    rows into the pages in one bulk scatter at window end (the same
+    read-only-cache discipline as ``decode_step_recent``). Unlike the
+    ring sampler there is no sliding window: pages are append-only and
+    the engine caps each request at ``block_size`` total tokens."""
+    cfg = model.config
+    s = tokens.shape[0]
+    pmax = bt.shape[1]
+    ps = pool_k.shape[-1]
+    rr = rk.shape[3]
+    sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
+    sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    # paged slot j of the gathered [W = Pmax*PS] view holds logical
+    # position j for that slot; valid iff already flushed to the pool
+    idx = jnp.arange(pmax * ps)
+    mask_pool = jnp.where(
+        idx[None, :] < pooled_len[:, None], 0.0, -jnp.inf
+    ).astype(jnp.float32)  # [S, W]
+    # recent row j holds the slot's window position pooled_len + j;
+    # causal bound j <= r (rows > r are unwritten). Always >= 1 valid
+    # row (row r = the token itself), so empty slots never softmax over
+    # an all-masked axis.
+    ridx = jnp.arange(rr)
+    mask_rec = jnp.where(ridx <= r, 0.0, -jnp.inf).astype(jnp.float32)
+    pos_c = jnp.clip(pos, 0, rope_len - 1)
+    sin_rows = jnp.take(sin_t, pos_c, axis=0)[:, None, None, :]  # [S,1,1,C/2]
+    cos_rows = jnp.take(cos_t, pos_c, axis=0)[:, None, None, :]
+
+    h = embed_tokens(model.wte, tokens[:, None])  # [S, 1, D]
+    sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
+    for i in range(cfg.n_layer):
+        block = jax.tree.map(lambda a: a[i], model.blocks)
+        h, rk, rv = block.decode_paged_at(
+            h, pool_k, pool_v, bt, rk, rv, i, r, mask_pool, mask_rec,
+            sin_h, cos_h,
+        )
+    h = model.ln_f(h)
+    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [S, V]
     return logits, rk, rv
 
 

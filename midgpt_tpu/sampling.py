@@ -1,10 +1,18 @@
-"""KV-cached autoregressive generation.
+"""KV-cached autoregressive generation (fixed batch).
 
 Replaces the reference sampler's pad-to-block_size full re-forward per token
 (/root/reference/sample.py:68-95) with prefill + incremental decode under
 ``lax.scan`` — one compiled program, O(T) per token, static shapes.
 Capability parity: temperature-scaled categorical sampling; adds greedy
-(temperature=0) and top-k."""
+(temperature=0) and top-k.
+
+This module is the FIXED-BATCH path (one ring cache sized for the batch,
+all requests start and stop together) and the exact-parity oracle the
+serving tests compare against. Under real traffic — requests arriving and
+finishing independently — route through ``midgpt_tpu.serving`` instead:
+paged KV pool, continuous batching, and K decode steps fused per XLA
+dispatch (``serving.generate_served`` is the drop-in batch entry point;
+``sample.py --serve`` uses it)."""
 
 from __future__ import annotations
 

@@ -1,0 +1,91 @@
+"""Serving subsystem: paged KV cache + continuous-batching engine.
+
+Public surface:
+
+- :class:`~midgpt_tpu.serving.paged.PagedKVPool`,
+  :class:`~midgpt_tpu.serving.paged.PageAllocator` — the page pool and
+  its host-side free-list allocator.
+- :class:`~midgpt_tpu.serving.engine.ServingEngine` — the scheduler:
+  ``submit()`` requests, ``run()`` to drain, per-request
+  :class:`~midgpt_tpu.serving.engine.Request` records with TTFT/latency
+  timestamps.
+- :func:`~midgpt_tpu.serving.engine.make_decode_window` — the fused
+  K-step decode program (also what the analysis CLI audits for donation
+  and host-sync regressions: ``python -m midgpt_tpu.analysis --serving``).
+- :func:`generate_served` — one-shot batch generation through the engine
+  (the ``sample.py --serve`` path).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import numpy as np
+
+from midgpt_tpu.serving.engine import (
+    Request,
+    ServingEngine,
+    make_decode_window,
+    make_prefill_program,
+)
+from midgpt_tpu.serving.paged import (
+    PageAllocator,
+    PagedKVPool,
+    flush_recent,
+    pages_needed,
+    write_prompt_pages,
+)
+
+__all__ = [
+    "PageAllocator",
+    "PagedKVPool",
+    "Request",
+    "ServingEngine",
+    "flush_recent",
+    "generate_served",
+    "make_decode_window",
+    "make_prefill_program",
+    "pages_needed",
+    "write_prompt_pages",
+]
+
+
+def generate_served(
+    model,
+    prompts: tp.Sequence[np.ndarray],
+    max_new_tokens: int,
+    *,
+    eos_id: tp.Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
+    slots: tp.Optional[int] = None,
+    window: int = 4,
+    page_size: int = 16,
+    cache_dtype=None,
+    seed: int = 0,
+    mesh=None,
+) -> tp.List[np.ndarray]:
+    """One-shot batch generation routed through the serving engine: submit
+    every prompt, drain, return the generated token arrays in submission
+    order. The engine path to the fixed-batch ``sampling.generate`` —
+    same greedy tokens, 1/K the decode dispatches, and per-request early
+    exit at ``eos_id``."""
+    import jax.numpy as jnp
+
+    eng = ServingEngine(
+        model,
+        slots=slots if slots is not None else max(1, min(8, len(prompts))),
+        page_size=page_size,
+        window=window,
+        temperature=temperature,
+        top_k=top_k,
+        cache_dtype=cache_dtype if cache_dtype is not None else jnp.bfloat16,
+        seed=seed,
+        mesh=mesh,
+    )
+    rids = [
+        eng.submit(p, max_new_tokens, eos_id=eos_id, seed=i)
+        for i, p in enumerate(prompts)
+    ]
+    finished = eng.run()
+    return [np.asarray(finished[r].tokens, np.int32) for r in rids]

@@ -1,0 +1,575 @@
+"""Continuous-batching serving engine: paged KV pool + fused K-step decode.
+
+The fixed-batch sampler (midgpt_tpu.sampling.generate) holds one ring
+cache sized per request batch and dispatches every decode step; under real
+traffic that leaves decode slots idle whenever requests finish early and
+pays the full per-dispatch latency (+25-50 ms/launch on a bad relay day,
+PERF.md r5) once per generated token. This engine replaces both:
+
+- **Paged KV** (serving.paged): requests own page lists in a shared pool,
+  so admission is a page allocation, eviction a free — no cache reshapes.
+- **Continuous batching**: a host-side scheduler admits queued requests
+  into free decode slots at every window boundary, interleaves their
+  prefills with decode, and evicts (re-queues with progress kept) under
+  page pressure — slots stay full under mixed traffic.
+- **Fused multi-token dispatch** (the PR 2 design, ported to decode): one
+  jitted, state-donating ``lax.scan`` runs K whole-model decode steps —
+  all layers, sampling, and the bulk page flush — per XLA launch.
+  Per-slot EOS/length masks are carried IN-SCAN: finished requests pad
+  harmlessly (writes dropped, emissions masked) until the next host-side
+  swap boundary. Dispatches per generated token drop from 1 per token to
+  1/K per active batch.
+
+Determinism contract: per-request sampling keys derive from
+``fold_in(fold_in(key, request_seed), tokens_emitted_so_far)`` — the token
+stream of a request is a function of the request alone, independent of
+which slot it lands in, the window size K, batch composition, and any
+mid-run eviction/re-admission.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from midgpt_tpu.models.gpt import GPT, decode_step_paged
+from midgpt_tpu.serving.paged import (
+    PageAllocator,
+    PagedKVPool,
+    flush_recent,
+    pages_needed,
+    write_prompt_pages,
+)
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Compiled programs
+# ---------------------------------------------------------------------------
+
+
+def make_decode_window(
+    model: GPT,
+    *,
+    slots: int,
+    window: int,
+    pmax: int,
+    rope_len: int,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
+    mesh=None,
+):
+    """The fused K-step decode program: ONE jitted, pool/logits-donating
+    ``lax.scan`` over ``window`` whole-model decode steps.
+
+    Per scan step: sample each slot's next token from the carried logits,
+    mark slots that just hit EOS/length done, run the paged decode step
+    (models.gpt.decode_step_paged) for all slots SIMD-style, and collect
+    (token, emit-mask, write-mask) as scan outputs. After the scan the
+    window's recent K/V rows flush into the pages in one bulk scatter —
+    still inside the same compiled program, so steady-state decode is
+    exactly one XLA dispatch per K generated tokens per active batch.
+
+    Finished/empty slots ride along masked: they sample pad, their page
+    writes route to the drop sentinel, and their emissions are masked out
+    host-side — the scan shape never depends on traffic.
+    """
+    from midgpt_tpu.parallel.sharding import axis_rules
+    from midgpt_tpu.sampling import _sample_token
+
+    cfg = model.config
+    rshape = (cfg.n_layer, slots, cfg.kv_heads, window, cfg.head_dim)
+
+    def window_fn(
+        pool: PagedKVPool,  # DONATED
+        logits: Array,  # [S, V] f32 — per-slot next-token logits; DONATED
+        bt: Array,  # [S, Pmax] int32 block tables
+        pooled_len: Array,  # [S] int32 — tokens resident in the pool
+        done: Array,  # [S] bool — finished or empty slot
+        emitted: Array,  # [S] int32 — tokens emitted so far per request
+        budget: Array,  # [S] int32 — max_new_tokens per request
+        eos: Array,  # [S] int32 — per-request EOS id (-1 = none)
+        seeds: Array,  # [S] int32 — per-request sampling seed
+        key: Array,  # base PRNG key (engine-constant)
+    ):
+        assert bt.shape == (slots, pmax), (
+            f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
+        )
+        with axis_rules(mesh):
+            rk = jnp.zeros(rshape, pool.k.dtype)
+            rv = jnp.zeros(rshape, pool.k.dtype)
+
+            def sample(lg, em):
+                if temperature == 0.0:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # per-request key stream: (seed, emitted-count) — slot-,
+                # window-, and eviction-invariant
+                ks = jax.vmap(
+                    lambda sd, ti: jax.random.fold_in(
+                        jax.random.fold_in(key, sd), ti
+                    )
+                )(seeds, em)
+                return jax.vmap(
+                    lambda l1, k1: _sample_token(
+                        l1[None], k1, temperature, top_k
+                    )[0]
+                )(lg, ks)
+
+            def body(carry, r):
+                logits, rk, rv, done, emitted = carry
+                pre_done = done
+                tok = sample(logits, emitted)
+                tok = jnp.where(pre_done, jnp.int32(pad_id), tok)
+                emitted = emitted + (~pre_done).astype(jnp.int32)
+                hit_eos = (~pre_done) & (tok == eos)
+                hit_len = (~pre_done) & (emitted >= budget)
+                done = pre_done | hit_eos | hit_len
+                # the just-sampled token is this step's model input; its
+                # K/V row is only needed if a real token can follow it
+                write_valid = ~done
+                pos = pooled_len + r  # per-slot absolute position
+                new_logits, rk, rv = decode_step_paged(
+                    model, tok, pos, pool.k, pool.v, bt, rk, rv, r,
+                    pooled_len, rope_len,
+                )
+                # the carry is f32 regardless of compute dtype (an exact
+                # widening — sampling sees the same values either way)
+                new_logits = new_logits.astype(logits.dtype)
+                return (
+                    (new_logits, rk, rv, done, emitted),
+                    (tok, ~pre_done, write_valid),
+                )
+
+            (logits, rk, rv, done, emitted), (toks, emit, wvalid) = (
+                jax.lax.scan(
+                    body,
+                    (logits, rk, rv, done, emitted),
+                    jnp.arange(window, dtype=jnp.int32),
+                )
+            )
+            pool = flush_recent(
+                pool, rk, rv, bt, pooled_len, jnp.transpose(wvalid)
+            )
+            new_len = pooled_len + jnp.sum(wvalid.astype(jnp.int32), axis=0)
+        return pool, logits, toks, emit, done, new_len, emitted
+
+    return jax.jit(window_fn, donate_argnums=(0, 1))
+
+
+def make_prefill_program(model: GPT, *, prompt_len: int, mesh=None):
+    """A prefill program for one padded prompt length: one batched forward
+    collecting per-layer K/V (models.gpt prefill path), a bulk page write,
+    and the admitted slot's logits row updated in place. One compile per
+    padded length — the engine buckets prompts to powers-of-two page
+    counts to bound recompiles."""
+    from midgpt_tpu.parallel.sharding import axis_rules
+
+    cfg = model.config
+    assert prompt_len <= cfg.block_size, (prompt_len, cfg.block_size)
+    impl = (
+        "auto"
+        if cfg.attn_impl in ("ring", "ulysses", "flash", "fused")
+        else cfg.attn_impl
+    )
+
+    def prefill_fn(
+        pool: PagedKVPool,  # DONATED
+        logits: Array,  # [S, V] DONATED
+        slot: Array,  # [] int32 — the admitted slot
+        tokens: Array,  # [1, prompt_len] int32 (right-padded)
+        real_len: Array,  # [] int32
+        page_rows: Array,  # [prompt_len // page_size] int32 (pad = sentinel)
+    ):
+        with axis_rules(mesh):
+            h, (ks, vs) = model.hidden(
+                tokens, deterministic=True, attn_impl=impl, return_kv=True
+            )  # ks/vs: [L, 1, Hkv, P, C]
+            pool = write_prompt_pages(pool, ks[:, 0], vs[:, 0], page_rows)
+            h_last = jax.lax.dynamic_slice_in_dim(
+                h, real_len - 1, 1, axis=1
+            )[:, 0]  # [1, D]
+            row = (h_last @ model.head_weight(h_last.dtype)).astype(
+                logits.dtype
+            )[0]
+            logits = jax.lax.dynamic_update_slice(
+                logits, row[None], (slot, jnp.zeros((), slot.dtype))
+            )
+        return pool, logits
+
+    return jax.jit(prefill_fn, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# Requests + engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle record."""
+
+    rid: int
+    prompt: np.ndarray  # [p] int32 admission context (original prompt, or
+    # prompt0 + generated-so-far after an eviction re-queue)
+    max_new_tokens: int
+    # the cropped ORIGINAL prompt — evictions rebuild the admission
+    # context from this, never from an already-grown prompt
+    prompt0: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int32)
+    )
+    eos_id: int = -1  # -1 = no EOS (run to max_new_tokens)
+    seed: int = 0
+    submit_time: float = 0.0
+    first_token_time: tp.Optional[float] = None
+    finish_time: tp.Optional[float] = None
+    tokens: tp.List[int] = dataclasses.field(default_factory=list)
+    evictions: int = 0
+
+    @property
+    def done(self) -> bool:
+        return self.finish_time is not None
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over ``slots`` decode lanes.
+
+    Every :meth:`step` is one scheduler window: admit queued requests into
+    free slots (prefill + page allocation), top up page allocations for
+    the coming K tokens (evicting the youngest request under pressure —
+    its progress is kept and it re-queues with prompt+generated), launch
+    ONE fused K-step decode dispatch for all slots, then harvest emitted
+    tokens / finished requests with a single device->host read.
+
+    Capacity contract: a request must fit its context in ``block_size``
+    (prompts are cropped to ``block_size - max_new_tokens`` like the
+    reference sampler crops to the window, sample.py:74).
+    """
+
+    def __init__(
+        self,
+        model: GPT,
+        *,
+        slots: int = 4,
+        page_size: int = 16,  # tile-aligned at C=64; same default everywhere
+        num_pages: tp.Optional[int] = None,
+        window: int = 4,
+        temperature: float = 0.0,
+        top_k: tp.Optional[int] = None,
+        cache_dtype=jnp.bfloat16,
+        pad_id: int = 0,
+        seed: int = 0,
+        max_prefills_per_window: tp.Optional[int] = None,
+        mesh=None,
+        clock: tp.Callable[[], float] = time.monotonic,
+    ):
+        assert slots >= 1 and window >= 1 and page_size >= 1
+        cfg = model.config
+        # page grid must tile the context: otherwise a near-block prompt
+        # padded up to the page grid exceeds block_size and prefill
+        # cannot run (caught in code review)
+        assert cfg.block_size % page_size == 0, (
+            f"page_size {page_size} must divide block_size {cfg.block_size}"
+        )
+        self.model = model
+        self.slots = slots
+        self.window = window
+        self.page_size = page_size
+        self.pad_id = pad_id
+        self.clock = clock
+        self.block = cfg.block_size
+        self.pmax = pages_needed(self.block, page_size)
+        if num_pages is None:
+            num_pages = slots * self.pmax  # full occupancy, no eviction
+        self.alloc = PageAllocator(num_pages)
+        self.pool = PagedKVPool.init(cfg, num_pages, page_size, cache_dtype)
+        self.logits = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+        self._key = jax.random.PRNGKey(seed)
+        self._sentinel = num_pages
+        self._mesh = mesh
+        self._max_prefills = (
+            max_prefills_per_window
+            if max_prefills_per_window is not None
+            else slots
+        )
+
+        # host-side slot state
+        self.bt = np.full((slots, self.pmax), self._sentinel, np.int32)
+        self.pooled_len = np.zeros((slots,), np.int32)
+        self.done = np.ones((slots,), bool)  # empty slots ride as done
+        self.emitted = np.zeros((slots,), np.int32)
+        self.budget = np.zeros((slots,), np.int32)
+        self.eos = np.full((slots,), -1, np.int32)
+        self.seeds = np.zeros((slots,), np.int32)
+        self.slot_pages: tp.List[tp.List[int]] = [[] for _ in range(slots)]
+        self.slot_req: tp.List[tp.Optional[Request]] = [None] * slots
+
+        self.queue: tp.Deque[Request] = collections.deque()
+        self.finished: tp.Dict[int, Request] = {}
+        self._next_rid = 0
+
+        self._window_fn = make_decode_window(
+            model,
+            slots=slots,
+            window=window,
+            pmax=self.pmax,
+            rope_len=self.block,
+            pad_id=pad_id,
+            temperature=temperature,
+            top_k=top_k,
+            mesh=mesh,
+        )
+        self._prefill_fns: tp.Dict[int, tp.Any] = {}
+
+        # counters (bench_serving / tests)
+        self.decode_dispatches = 0
+        self.prefill_dispatches = 0
+        self.tokens_generated = 0
+        self.windows = 0
+        self.occupancy_sum = 0
+        self.evictions = 0
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        *,
+        eos_id: tp.Optional[int] = None,
+        seed: int = 0,
+    ) -> int:
+        """Queue a request; returns its id. Prompts are cropped to the last
+        ``block_size - max_new_tokens`` tokens so the whole context fits."""
+        assert max_new_tokens >= 1, max_new_tokens
+        assert max_new_tokens < self.block, (
+            f"max_new_tokens {max_new_tokens} must leave room for at least "
+            f"one prompt token in block_size {self.block}"
+        )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        assert prompt.size >= 1, "empty prompt"
+        keep = self.block - max_new_tokens
+        if prompt.size > keep:
+            prompt = prompt[-keep:]
+        lifetime = pages_needed(
+            int(prompt.size) + max_new_tokens, self.page_size
+        )
+        assert lifetime <= self.alloc.num_pages, (
+            f"request needs {lifetime} pages over its lifetime but the pool "
+            f"holds {self.alloc.num_pages}; raise num_pages"
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(
+            Request(
+                rid=rid,
+                prompt=prompt,
+                prompt0=prompt,
+                max_new_tokens=max_new_tokens,
+                eos_id=-1 if eos_id is None else int(eos_id),
+                seed=seed,
+                submit_time=self.clock(),
+            )
+        )
+        return rid
+
+    # -- internals ----------------------------------------------------------
+
+    def _active_slots(self) -> tp.List[int]:
+        return [s for s in range(self.slots) if self.slot_req[s] is not None]
+
+    def _prefill_bucket(self, p: int) -> int:
+        """Padded prompt length: pages rounded up to a power of two, so the
+        number of compiled prefill programs is O(log(block/page_size))."""
+        n = pages_needed(p, self.page_size)
+        n = 1 << (n - 1).bit_length()
+        return min(n * self.page_size, self.pmax * self.page_size)
+
+    def _admit(self) -> None:
+        admitted = 0
+        for s in range(self.slots):
+            if not self.queue or admitted >= self._max_prefills:
+                break
+            if self.slot_req[s] is not None:
+                continue
+            req = self.queue[0]
+            p = int(req.prompt.size)
+            n_pages = pages_needed(p, self.page_size)
+            if not self.alloc.can_alloc(n_pages):
+                break  # head-of-line blocks: pages free up as requests end
+            self.queue.popleft()
+            pages = self.alloc.alloc(n_pages)
+            bucket = self._prefill_bucket(p)
+            toks = np.full((1, bucket), self.pad_id, np.int32)
+            toks[0, :p] = req.prompt
+            rows = np.full((bucket // self.page_size,), self._sentinel,
+                           np.int32)
+            rows[:n_pages] = pages
+            if bucket not in self._prefill_fns:
+                self._prefill_fns[bucket] = make_prefill_program(
+                    self.model, prompt_len=bucket, mesh=self._mesh
+                )
+            self.pool, self.logits = self._prefill_fns[bucket](
+                self.pool,
+                self.logits,
+                jnp.asarray(s, jnp.int32),
+                jnp.asarray(toks),
+                jnp.asarray(p, jnp.int32),
+                jnp.asarray(rows),
+            )
+            self.prefill_dispatches += 1
+            self.slot_req[s] = req
+            self.slot_pages[s] = list(pages)
+            self.bt[s, :] = self._sentinel
+            self.bt[s, :n_pages] = pages
+            self.pooled_len[s] = p
+            self.done[s] = False
+            self.emitted[s] = len(req.tokens)
+            self.budget[s] = req.max_new_tokens
+            self.eos[s] = req.eos_id
+            self.seeds[s] = req.seed
+            admitted += 1
+
+    def _release_slot(self, s: int) -> None:
+        self.alloc.free(self.slot_pages[s])
+        self.slot_pages[s] = []
+        self.slot_req[s] = None
+        self.bt[s, :] = self._sentinel
+        self.pooled_len[s] = 0
+        self.done[s] = True
+
+    def _evict(self, s: int) -> None:
+        """Preempt slot ``s``: keep its progress (prompt grows by the
+        generated tokens, budget shrinks to the remainder) and re-queue it
+        at the FRONT so it resumes as soon as pages free up."""
+        req = self.slot_req[s]
+        assert req is not None
+        # rebuild from the ORIGINAL prompt (a second eviction appending to
+        # an already-grown prompt would duplicate the first eviction's
+        # tokens — caught in code review). prompt0 <= block - max_new, so
+        # prompt0 + generated always fits block - remaining: no cropping,
+        # and the continuation is identical to the un-evicted run
+        req.prompt = np.concatenate(
+            [req.prompt0, np.asarray(req.tokens, np.int32)]
+        )
+        req.evictions += 1
+        self._release_slot(s)
+        self.queue.appendleft(req)
+        self.evictions += 1
+
+    def _ensure_growth(self) -> None:
+        """Before the window, every active slot needs pages for up to K
+        more tokens; allocate on demand, evicting the youngest slot (by
+        admission recency ~ least progress) under pool pressure."""
+        for s in self._active_slots():
+            if self.slot_req[s] is None:
+                continue  # evicted by an earlier slot's pressure this pass
+            # growth is capped at the request's REMAINING budget, not the
+            # raw window: near end-of-generation pooled_len + window can
+            # point past the request's lifetime (and past the block
+            # table), and demanding those pages would crash or evict
+            # healthy requests for tokens that will never be written
+            remaining = int(self.budget[s]) - int(self.emitted[s])
+            tokens = int(self.pooled_len[s]) + min(self.window, remaining)
+            need = min(
+                pages_needed(tokens, self.page_size), self.pmax
+            ) - len(self.slot_pages[s])
+            while need > 0 and not self.alloc.can_alloc(need):
+                others = [v for v in self._active_slots() if v != s]
+                if not others:
+                    raise MemoryError(
+                        "page pool too small for a single request's window"
+                    )
+                # least progress loses: cheapest re-prefill on re-admission
+                self._evict(min(others, key=lambda v: len(self.slot_req[v].tokens)))
+            if need > 0:
+                pages = self.alloc.alloc(need)
+                start = len(self.slot_pages[s])
+                self.slot_pages[s].extend(pages)
+                self.bt[s, start : start + need] = pages
+
+    def step(self) -> bool:
+        """One scheduler window. Returns True while there is (or was) work."""
+        self._admit()
+        active = self._active_slots()
+        if not active:
+            return bool(self.queue)
+        self._ensure_growth()
+        active = self._active_slots()  # eviction may have changed it
+
+        (
+            self.pool, self.logits, toks, emit, done_d, new_len, emitted_d
+        ) = self._window_fn(
+            self.pool,
+            self.logits,
+            jnp.asarray(self.bt),
+            jnp.asarray(self.pooled_len),
+            jnp.asarray(self.done),
+            jnp.asarray(self.emitted),
+            jnp.asarray(self.budget),
+            jnp.asarray(self.eos),
+            jnp.asarray(self.seeds),
+            self._key,
+        )
+        self.decode_dispatches += 1
+        self.windows += 1
+        self.occupancy_sum += len(active)
+
+        # ONE device->host sync per window: the stacked [K, S] outputs
+        toks_h = np.asarray(toks)
+        emit_h = np.asarray(emit)
+        # np.array (copy): zero-copy views of jax buffers are read-only,
+        # and the scheduler mutates these in place
+        self.done = np.array(done_d)
+        self.pooled_len = np.array(new_len, np.int32)
+        self.emitted = np.array(emitted_d, np.int32)
+        now = self.clock()
+        for s in active:
+            req = self.slot_req[s]
+            new = [int(t) for r in range(self.window)
+                   for t in [toks_h[r, s]] if emit_h[r, s]]
+            if new and req.first_token_time is None:
+                req.first_token_time = now
+            req.tokens.extend(new)
+            self.tokens_generated += len(new)
+            if self.done[s]:
+                req.finish_time = now
+                self.finished[req.rid] = req
+                self._release_slot(s)
+        return True
+
+    def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
+        """Drive :meth:`step` until queue and slots drain; returns the
+        finished requests by id."""
+        for _ in range(max_windows):
+            if not self.queue and not self._active_slots():
+                break
+            self.step()
+        else:
+            raise RuntimeError(f"engine did not drain in {max_windows} windows")
+        return self.finished
+
+    # -- reporting ----------------------------------------------------------
+
+    def stats(self) -> tp.Dict[str, float]:
+        occ = self.occupancy_sum / max(1, self.windows * self.slots)
+        return {
+            "decode_dispatches": self.decode_dispatches,
+            "prefill_dispatches": self.prefill_dispatches,
+            "tokens_generated": self.tokens_generated,
+            "windows": self.windows,
+            "slot_occupancy": round(occ, 4),
+            "evictions": self.evictions,
+            "free_pages": self.alloc.free_pages,
+            "tokens_per_dispatch": round(
+                self.tokens_generated / max(1, self.decode_dispatches), 2
+            ),
+        }

@@ -1,0 +1,198 @@
+"""Paged KV-cache pool: fixed page arrays + per-request block tables.
+
+The serving replacement for per-request ring caches (midgpt_tpu.sampling):
+one shared pool of fixed-size pages per layer, and each live request owns
+an ordered list of page ids (its *block table*). Memory scales with the
+tokens actually resident — a request holding 37 tokens at page_size=16
+pins 3 pages, not a whole ``[B, Hkv, C, block_size]`` ring — which is what
+lets the continuous-batching scheduler keep decode slots full under mixed
+prompt/generation lengths (vLLM's PagedAttention / the TPU-native Ragged
+Paged Attention formulation, PAPERS.md).
+
+Layout: ``[L, num_pages, Hkv, C, page_size]`` — time is the minor dim
+inside a page for the same reason KVCache keeps it minor globally (full
+(8, 128) tiles when C = 64; see models.gpt.KVCache). Device-side reads go
+through a block-table gather (models.gpt.Attention.decode_paged_at);
+device-side writes are bulk scatters at window/prefill boundaries only
+(:func:`flush_recent`, :func:`write_prompt_pages`), so the pool stays
+read-only inside the fused decode scan. Out-of-range page ids (== the
+dedicated ``num_pages`` sentinel) drop their writes — that is how padded
+block-table tails and finished/inactive slots pad harmlessly.
+
+The allocator (:class:`PageAllocator`) is host-side and pure-Python: page
+accounting is control flow, not math, and it runs once per scheduler
+window, never inside jit.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.config import ModelConfig
+from midgpt_tpu.pytree import module, static
+
+Array = jax.Array
+
+
+@module
+class PagedKVPool:
+    """The shared page pool; leaves carry a leading n_layer axis like the
+    scan-stacked block params (and KVCache)."""
+
+    k: Array  # [L, NP, Hkv, C, PS]
+    v: Array  # [L, NP, Hkv, C, PS]
+    page_size: int = static()
+
+    @staticmethod
+    def init(
+        cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    ) -> "PagedKVPool":
+        assert num_pages >= 1 and page_size >= 1, (num_pages, page_size)
+        shape = (cfg.n_layer, num_pages, cfg.kv_heads, cfg.head_dim, page_size)
+        return PagedKVPool(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            page_size=page_size,
+        )
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+
+class PageAllocator:
+    """Host-side free-list allocator over pool page ids.
+
+    Invariants (tested): a page is held by at most one owner; ``free +
+    held == num_pages`` at all times; double-free and foreign-free raise.
+    Allocation is LIFO so a request that frees and re-allocates under
+    light load reuses hot pages (better HBM locality than FIFO cycling
+    through the whole pool)."""
+
+    def __init__(self, num_pages: int):
+        assert num_pages >= 1, num_pages
+        self.num_pages = num_pages
+        self._free: tp.List[int] = list(range(num_pages - 1, -1, -1))
+        self._held: tp.Set[int] = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def held_pages(self) -> int:
+        return len(self._held)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> tp.List[int]:
+        """Pop ``n`` pages off the free list; raises MemoryError when the
+        pool can't satisfy the request (the scheduler's cue to evict)."""
+        assert n >= 0, n
+        if n > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n}, free {len(self._free)} "
+                f"of {self.num_pages}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._held.update(pages)
+        return pages
+
+    def free(self, pages: tp.Iterable[int]) -> None:
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(f"freeing page {p} that is not held")
+            self._held.remove(p)
+            self._free.append(p)
+
+    def check(self) -> None:
+        """Assert the structural invariants (tests call this after every
+        mutation sequence)."""
+        assert len(self._free) + len(self._held) == self.num_pages
+        assert len(set(self._free)) == len(self._free), "free-list dup"
+        assert not (set(self._free) & self._held), "page both free and held"
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    """ceil(tokens / page_size) — pages a request at ``tokens`` resident
+    tokens pins."""
+    return -(-tokens // page_size)
+
+
+def flush_recent(
+    pool: PagedKVPool,
+    rk: Array,  # [L, S, Hkv, K, C] — the window's recent rows (time-major)
+    rv: Array,
+    bt: Array,  # [S, Pmax] int32 block tables
+    start_len: Array,  # [S] int32 — pool-resident tokens at window start
+    valid: Array,  # [S, K] bool — row j is a real token for slot s
+) -> PagedKVPool:
+    """Fold the decode window's recent rows into each slot's pages — one
+    bulk scatter per pool array, inside the same compiled window program.
+
+    Row j of slot s holds the K/V of position ``start_len[s] + j`` (valid
+    rows form a prefix: the window carries monotone done flags, so a
+    finished slot's tail rows are pad). Invalid rows are routed to the
+    out-of-range page sentinel and dropped by ``mode="drop"`` — finished
+    and empty slots cost nothing and corrupt nothing."""
+    l, s, hkv, kk, c = rk.shape
+    ps = pool.page_size
+    pmax = bt.shape[1]
+    np_sentinel = pool.num_pages
+    pos = start_len[:, None] + jnp.arange(kk)[None, :]  # [S, K]
+    page_idx = jnp.clip(pos // ps, 0, pmax - 1)
+    page = jnp.take_along_axis(bt, page_idx, axis=1)  # [S, K]
+    page = jnp.where(valid, page, np_sentinel)
+    off = pos % ps
+    # advanced indices at axes 1 and 4 are non-adjacent, so the broadcast
+    # [S*K] index dim moves to the FRONT of the updated slice: vals must
+    # arrive [S*K, L, Hkv, C]
+    vals_k = jnp.transpose(rk, (1, 3, 0, 2, 4)).reshape(s * kk, l, hkv, c)
+    vals_v = jnp.transpose(rv, (1, 3, 0, 2, 4)).reshape(s * kk, l, hkv, c)
+    pg, of = page.reshape(-1), off.reshape(-1)
+    return PagedKVPool(
+        k=pool.k.at[:, pg, :, :, of].set(
+            vals_k.astype(pool.k.dtype), mode="drop"
+        ),
+        v=pool.v.at[:, pg, :, :, of].set(
+            vals_v.astype(pool.v.dtype), mode="drop"
+        ),
+        page_size=ps,
+    )
+
+
+def write_prompt_pages(
+    pool: PagedKVPool,
+    ks: Array,  # [L, Hkv, P, C] — prompt K from prefill (post-rope)
+    vs: Array,  # [L, Hkv, P, C]
+    page_rows: Array,  # [P // PS] int32 — target pages (pad = sentinel)
+) -> PagedKVPool:
+    """Write a prefilled prompt's K/V into its allocated pages — one bulk
+    scatter per array, page-granular. P must be a multiple of page_size
+    (the engine pads prompts up to the page grid); the pad tail beyond the
+    real prompt length lands in the last allocated page as garbage that
+    ``pooled_len`` masking never reads, and pages beyond the allocation
+    carry the out-of-range sentinel and drop."""
+    l, hkv, p, c = ks.shape
+    ps = pool.page_size
+    assert p % ps == 0, f"prompt length {p} not a multiple of page_size {ps}"
+    n = p // ps
+    # [L, Hkv, P, C] -> time-minor page blocks [L, n, Hkv, C, PS]
+    def to_pages(a):
+        a = jnp.transpose(a, (0, 1, 3, 2))  # [L, Hkv, C, P]
+        a = a.reshape(l, hkv, c, n, ps)
+        return jnp.transpose(a, (0, 3, 1, 2, 4))  # [L, n, Hkv, C, PS]
+
+    return PagedKVPool(
+        k=pool.k.at[:, page_rows].set(
+            to_pages(ks).astype(pool.k.dtype), mode="drop"
+        ),
+        v=pool.v.at[:, page_rows].set(
+            to_pages(vs).astype(pool.v.dtype), mode="drop"
+        ),
+        page_size=ps,
+    )

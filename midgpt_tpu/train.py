@@ -406,14 +406,20 @@ def evaluate(
 
     All batches assemble host-side up front, transfer in one device_put
     pair, and sweep in ONE jitted scan call (make_eval_step) — the eval
-    interval costs a single dispatch per split instead of n_batches."""
+    interval costs a single dispatch per split instead of n_batches.
+    EVERY microbatch of each peeked batch feeds the sweep (the scan runs
+    n_batches * G bodies), so the evaluated token count per interval
+    matches the reference's full-batch eval (train.py:110-114; VERDICT r5
+    Next #6 — the old sweep took ``x[0]`` and silently evaluated 1/G of
+    the tokens when the eval loaders carried accumulation microbatches)."""
     spec = P(None, ("replica", "fsdp"), "sequence")
     pairs = [
         loader.peek(10_000_000 + seed_offset + i)  # disjoint from train steps
         for i in range(n_batches)
     ]
-    xs = np.stack([x[0] for x, _ in pairs])  # first microbatch only
-    ys = np.stack([y[0] for _, y in pairs])
+    # [n_batches * G, B, T]: microbatches are leading-axis scan bodies
+    xs = np.concatenate([x for x, _ in pairs])
+    ys = np.concatenate([y for _, y in pairs])
     xg = make_global_array(xs, mesh, spec)
     yg = make_global_array(ys, mesh, spec)
     return float(eval_step(params, xg, yg))
@@ -599,21 +605,23 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             seed=cfg.data_seed,
             process_index=proc,
         )
+        # eval loaders carry the FULL (g_accum, B) batch shape: evaluate()
+        # feeds every microbatch through the single-dispatch sweep scan, so
+        # the evaluated token count per interval is eval_batches * G * B * T
+        # — statistically matching the reference's full-batch eval
+        # (train.py:110-114)
         val_loader = Loader(
             shard=load_shard(os.path.join(cfg.data_dir, "val.bin"), proc, n_proc),
             block_size=t,
-            batch_shape=(1, local_b),
+            batch_shape=(cfg.g_accum_iters, local_b),
             seed=cfg.data_seed,
             process_index=proc,
             stream=1,
         )
-        # train-split eval gets its own single-microbatch loader (evaluate uses
-        # one microbatch; peeking the full (g_accum, B) train shape would gather
-        # g_accum x the data only to discard all but the first slice)
         train_eval_loader = Loader(
             shard=train_loader.shard,
             block_size=t,
-            batch_shape=(1, local_b),
+            batch_shape=(cfg.g_accum_iters, local_b),
             seed=cfg.data_seed,
             process_index=proc,
             stream=2,
@@ -634,6 +642,38 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             return _window_progs[kk]
 
         eval_step = make_eval_step(cfg, mesh)
+
+        # MoE router telemetry (VERDICT r5 Next #7): aux-loss value and
+        # dropped-claim fraction once per eval interval. Routing collapse
+        # is invisible in the loss curve (dropped tokens ride the
+        # residual), so it gets its own metrics keys via MetricLogger.
+        moe_stats_fn = None
+        if cfg.model.mlp == "moe":
+            compute_dtype = _dtype(cfg.compute_dtype)
+
+            def _moe_stats(params, x):
+                with axis_rules(mesh):
+                    from midgpt_tpu.parallel.sharding import shard_act
+
+                    params_c = cast_floating(params, compute_dtype)
+                    return params_c.moe_stats(shard_act(x, "batch", "seq"))
+
+            moe_stats_fn = jax.jit(_moe_stats)
+
+        def moe_telemetry(step: int, params) -> tp.Dict[str, float]:
+            """{"moe/aux", "moe/dropped_frac"} on one val microbatch; {}
+            for dense models."""
+            if moe_stats_fn is None:
+                return {}
+            x, _ = val_loader.peek(
+                10_000_000 + (0 if cfg.eval_fixed else step)
+            )
+            xg = make_global_array(
+                x[0], mesh, P(("replica", "fsdp"), "sequence")
+            )
+            from midgpt_tpu.utils.metrics import moe_router_metrics
+
+            return moe_router_metrics(moe_stats_fn(params, xg))
 
         # resolve_auto_knobs' HBM-fit estimate is calibrated on one chip
         # class (PERF.md); when it over-reaches on an unmeasured chip, the
@@ -873,7 +913,11 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     )
                     logger.log(
                         w_start,
-                        {"loss/train": train_loss, "loss/val": val_loss},
+                        {
+                            "loss/train": train_loss,
+                            "loss/val": val_loss,
+                            **moe_telemetry(w_start, state.params),
+                        },
                     )
                     final.update(
                         {"train_loss": train_loss, "val_loss": val_loss}
@@ -998,7 +1042,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     eval_step, state.params, train_eval_loader, mesh, n_eval, eoff
                 )
                 val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, eoff)
-                logger.log(itr, {"loss/train": train_loss, "loss/val": val_loss})
+                logger.log(
+                    itr,
+                    {
+                        "loss/train": train_loss,
+                        "loss/val": val_loss,
+                        **moe_telemetry(itr, state.params),
+                    },
+                )
                 final.update({"train_loss": train_loss, "val_loss": val_loss})
 
             xg, yg = prefetch.next()

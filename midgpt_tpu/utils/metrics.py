@@ -65,6 +65,20 @@ def mfu(tokens_per_sec: float, model: ModelConfig, n_devices: int) -> float:
     return achieved / peak
 
 
+def moe_router_metrics(stats: tp.Mapping[str, tp.Any]) -> tp.Dict[str, float]:
+    """Schema for the per-eval-interval MoE router telemetry (VERDICT r5
+    Next #7): ``moe/aux`` (load-balance aux, 1.0 = perfectly balanced,
+    summed over layers like the training loss term) and
+    ``moe/dropped_frac`` (fraction of routing claims past expert
+    capacity — the silent failure mode: dropped tokens ride the residual
+    and never show in the loss curve). ``stats`` is
+    ``models.gpt.GPT.moe_stats``'s output."""
+    return {
+        "moe/aux": float(stats["aux"]),
+        "moe/dropped_frac": float(stats["dropped_frac"]),
+    }
+
+
 def _load_or_create_wandb_id(rundir: str, wandb_mod) -> tp.Optional[str]:
     """Read rundir/wandb_id.txt, creating it with a fresh id on first run
     (parity: /root/reference/launch.py:60-67). Returns None when the rundir

@@ -75,6 +75,26 @@ def main() -> None:
         "--chunk_len", type=_positive_int, default=64,
         help="decode chunk length (recent-KV buffer rows; perf knob)",
     )
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="route generation through the continuous-batching serving "
+        "engine (midgpt_tpu.serving): paged KV + fused K-step decode "
+        "dispatch; one request per sample, early exit at --eos_id. "
+        "NOTE: the engine's context is capped at block_size (prompts "
+        "crop to block_size - max_new_tokens; no sliding window)",
+    )
+    ap.add_argument(
+        "--serve_window", type=_positive_int, default=8,
+        help="decode steps fused per XLA dispatch in --serve mode",
+    )
+    ap.add_argument(
+        "--serve_page_size", type=_positive_int, default=16,
+        help="KV page size (tokens) in --serve mode",
+    )
+    ap.add_argument(
+        "--eos_id", type=int, default=None,
+        help="stop a request early at this token id (--serve mode only)",
+    )
     from midgpt_tpu.utils.platform_pin import add_platform_arg, apply_platform
 
     add_platform_arg(ap)
@@ -144,6 +164,25 @@ def main() -> None:
     prompt = np.tile(prompt[None, :], (args.num_samples, 1))
 
     model = cast_floating(model, jnp.bfloat16)
+    if args.serve:
+        from midgpt_tpu.serving import generate_served
+
+        outs = generate_served(
+            model,
+            [prompt[i] for i in range(args.num_samples)],
+            args.max_new_tokens,
+            eos_id=args.eos_id,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            window=args.serve_window,
+            page_size=args.serve_page_size,
+            seed=args.seed,
+            mesh=mesh,
+        )
+        for i in range(args.num_samples):
+            print("-" * 40)
+            print(start + decode(outs[i]))
+        return
     sampler = make_sampler(
         args.max_new_tokens,
         mesh=mesh,

@@ -1,0 +1,173 @@
+"""Serving bench: continuous-batching throughput under a Poisson request mix.
+
+Drives midgpt_tpu.serving.ServingEngine with seeded Poisson arrivals
+(random prompt/generation lengths), measures end-to-end on the real
+clock, and emits ONE JSON record:
+
+  serve_tok_s            generated tokens/s over the whole trace
+  serve_ttft_p50_ms      time-to-first-token, median (arrival -> first token)
+  serve_ttft_p99_ms      ... and p99
+  serve_slot_occupancy   mean fraction of decode slots busy per window
+  serve_decode_dispatches / serve_prefill_dispatches
+  serve_tokens_per_dispatch   steady-state K * slots when saturated
+
+The decode-dispatch arithmetic is the point (PERF.md): the fixed-batch
+sampler launches one XLA dispatch per generated token; the engine fuses K
+whole-model steps per launch, so the dispatch count is ~tokens/(K*slots)
+plus one prefill per admission. Random-init weights — throughput only.
+
+    python scripts/bench_serving.py                 # 124M shape on device
+    python scripts/bench_serving.py --preset tiny   # CPU sanity run
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("124m", "tiny"), default="124m")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--rate", type=float, default=16.0,
+                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--window", type=int, default=8,
+                    help="decode steps fused per dispatch (K)")
+    ap.add_argument("--page_size", type=int, default=16)
+    ap.add_argument("--min_prompt", type=int, default=32)
+    ap.add_argument("--max_prompt", type=int, default=256)
+    ap.add_argument("--min_new", type=int, default=32)
+    ap.add_argument("--max_new", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default "
+                    "artifacts/bench_serving.json; the r6 queue's K-ladder "
+                    "passes distinct paths so records don't overwrite)")
+    from midgpt_tpu.utils.platform_pin import add_platform_arg, apply_platform
+
+    add_platform_arg(ap)
+    args = ap.parse_args()
+    apply_platform(args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from midgpt_tpu.config import get_config
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving import ServingEngine
+
+    if args.preset == "tiny":
+        from midgpt_tpu.config import ModelConfig
+
+        cfg = ModelConfig(
+            block_size=128, vocab_size=256, n_layer=2, n_head=4, n_embd=64,
+            dropout=0.0, attn_impl="naive", remat="none",
+        )
+        args.min_prompt, args.max_prompt = 4, 16
+        args.min_new, args.max_new = 4, 16
+        args.requests = min(args.requests, 16)
+        args.rate = 1e9  # arrivals immediate: CPU sanity, not latency
+    else:
+        cfg = dataclasses.replace(
+            get_config("openwebtext").model, attn_impl="auto"
+        )
+    assert args.max_prompt + args.max_new <= cfg.block_size, (
+        "request mix must fit block_size"
+    )
+    model = cast_floating(GPT.init(jax.random.PRNGKey(0), cfg), jnp.bfloat16)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
+    plens = rng.integers(args.min_prompt, args.max_prompt + 1, args.requests)
+    nnews = rng.integers(args.min_new, args.max_new + 1, args.requests)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
+        for p in plens
+    ]
+
+    eng = ServingEngine(
+        model,
+        slots=args.slots,
+        page_size=args.page_size,
+        window=args.window,
+        temperature=0.0,
+        seed=args.seed,
+    )
+
+    # warmup: compile the window + the prefill buckets the trace will hit
+    buckets = sorted({eng._prefill_bucket(int(p)) for p in plens})
+    eng.submit(prompts[0], int(nnews[0]))
+    eng.run()
+    for b in buckets:
+        eng.submit(np.zeros((max(1, b - 1),), np.int32), 1)
+    eng.run()
+    eng.finished.clear()
+    for attr in ("decode_dispatches", "prefill_dispatches",
+                 "tokens_generated", "windows", "occupancy_sum",
+                 "evictions"):
+        setattr(eng, attr, 0)
+
+    t0 = time.monotonic()
+    submitted = 0
+    while submitted < args.requests or eng.queue or eng._active_slots():
+        now = time.monotonic() - t0
+        while submitted < args.requests and arrivals[submitted] <= now:
+            eng.submit(
+                prompts[submitted], int(nnews[submitted]),
+                seed=submitted,
+            )
+            submitted += 1
+        progressed = eng.step()
+        if not progressed and submitted < args.requests:
+            time.sleep(
+                max(0.0, arrivals[submitted] - (time.monotonic() - t0))
+            )
+    wall = time.monotonic() - t0
+
+    ttfts = sorted(
+        (r.first_token_time - r.submit_time) * 1e3
+        for r in eng.finished.values()
+    )
+    pct = lambda q: ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))]  # noqa: E731
+    st = eng.stats()
+    record = {
+        "device": jax.devices()[0].device_kind,
+        "serve_shape": (
+            f"{args.preset} S={args.slots} K={args.window} "
+            f"page={args.page_size}"
+        ),
+        "serve_requests": args.requests,
+        "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
+        "serve_wall_s": round(wall, 3),
+        "serve_tok_s": round(st["tokens_generated"] / wall, 1),
+        "serve_ttft_p50_ms": round(pct(0.50), 1),
+        "serve_ttft_p99_ms": round(pct(0.99), 1),
+        "serve_slot_occupancy": st["slot_occupancy"],
+        "serve_decode_dispatches": st["decode_dispatches"],
+        "serve_prefill_dispatches": st["prefill_dispatches"],
+        "serve_tokens_generated": st["tokens_generated"],
+        "serve_tokens_per_dispatch": st["tokens_per_dispatch"],
+        "serve_evictions": st["evictions"],
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

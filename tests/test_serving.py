@@ -1,0 +1,445 @@
+"""Serving engine (midgpt_tpu.serving): page-allocator invariants, paged
+decode parity against the exact sampler, fused K-step window vs K=1
+(including EOS inside a window), and scheduler admit/evict behavior under
+scripted traces. Beyond the reference (its sampler is fixed-batch,
+full-re-forward per token, sample.py:68-95)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from midgpt_tpu.config import ModelConfig
+from midgpt_tpu.models.gpt import (
+    GPT,
+    KVCache,
+    decode_step,
+    decode_step_paged,
+    prefill,
+)
+from midgpt_tpu.sampling import generate
+from midgpt_tpu.serving import (
+    PageAllocator,
+    PagedKVPool,
+    ServingEngine,
+    flush_recent,
+    generate_served,
+    pages_needed,
+    write_prompt_pages,
+)
+
+CFG = ModelConfig(
+    block_size=64, vocab_size=96, n_layer=2, n_head=4, n_embd=32,
+    dropout=0.0, attn_impl="naive", remat="none",
+)
+
+
+def _model():
+    return GPT.init(jax.random.PRNGKey(0), CFG)
+
+
+def _prompts(n, base_len=5, stride=3):
+    return [
+        np.asarray(
+            jax.random.randint(
+                jax.random.PRNGKey(100 + i), (base_len + stride * i,), 0,
+                CFG.vocab_size,
+            )
+        )
+        for i in range(n)
+    ]
+
+
+def _exact(model, prompt, n_new):
+    """The existing exact sampler, greedy, per request."""
+    return np.asarray(
+        generate(
+            model, jnp.asarray(prompt)[None], n_new,
+            key=jax.random.PRNGKey(9), temperature=0.0,
+            cache_dtype=jnp.float32,
+        )
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# Page allocator invariants
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_alloc_free_roundtrip():
+    a = PageAllocator(8)
+    p1 = a.alloc(3)
+    p2 = a.alloc(5)
+    a.check()
+    assert a.free_pages == 0 and a.held_pages == 8
+    assert len(set(p1) | set(p2)) == 8, "pages must be unique across owners"
+    a.free(p1)
+    a.check()
+    assert a.free_pages == 3
+    p3 = a.alloc(2)
+    a.check()
+    assert not set(p3) & set(p2), "freed-then-realloc'd pages stay disjoint"
+
+
+def test_allocator_exhaustion_and_double_free():
+    a = PageAllocator(4)
+    held = a.alloc(4)
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+    a.free(held[:2])
+    with pytest.raises(ValueError):
+        a.free(held[:1])  # double free
+    with pytest.raises(ValueError):
+        a.free([99])  # foreign page
+    a.check()
+
+
+def test_allocator_fragmentation_reuse():
+    """Interleaved alloc/free must never lose pages: after any sequence,
+    free + held == num_pages and a full-pool alloc succeeds once all owners
+    release."""
+    a = PageAllocator(16)
+    owners = [a.alloc(n) for n in (2, 3, 4, 7)]  # pool exactly full
+    a.check()
+    a.free(owners[1])
+    a.free(owners[3])
+    a.check()
+    b = a.alloc(10)  # exactly the freed count
+    a.check()
+    assert a.free_pages == 0
+    a.free(owners[0] + owners[2] + b)
+    a.check()
+    assert len(a.alloc(16)) == 16  # nothing leaked
+
+
+def test_pages_needed():
+    assert pages_needed(1, 8) == 1
+    assert pages_needed(8, 8) == 1
+    assert pages_needed(9, 8) == 2
+    assert pages_needed(64, 16) == 4
+
+
+# ---------------------------------------------------------------------------
+# Paged decode parity (logits + tokens) vs the exact sampler / oracle
+# ---------------------------------------------------------------------------
+
+
+def test_paged_decode_logits_match_decode_step_oracle():
+    """Teacher-forced: decode_step_paged against the per-token decode_step
+    ring oracle at every position, across page boundaries."""
+    model = _model()
+    p, n_steps, ps = 5, 13, 4  # crosses several page boundaries
+    total = p + n_steps
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (1, total), 0, CFG.vocab_size
+    )
+
+    cache = KVCache.init(CFG, 1, total, dtype=jnp.float32)
+    _, cache = prefill(model, tokens[:, :p], cache)
+    oracle = []
+    for t in range(p, total):
+        lo, cache = decode_step(
+            model, tokens[:, t], jnp.asarray(t, jnp.int32), cache,
+            rope_len=CFG.block_size,
+        )
+        oracle.append(np.asarray(lo))
+
+    pmax = pages_needed(CFG.block_size, ps)
+    pool = PagedKVPool.init(CFG, pmax, ps, dtype=jnp.float32)
+    pad = pages_needed(p, ps) * ps
+    h, (ks, vs) = model.hidden(
+        jnp.pad(tokens[:, :p], ((0, 0), (0, pad - p))), return_kv=True
+    )
+    rows = np.full((pad // ps,), pool.num_pages, np.int32)
+    rows[: pages_needed(p, ps)] = np.arange(pages_needed(p, ps))
+    pool = write_prompt_pages(pool, ks[:, 0], vs[:, 0], jnp.asarray(rows))
+
+    bt = np.full((1, pmax), pool.num_pages, np.int32)
+    bt[0, :pmax] = np.arange(pmax)  # identity block table
+    bt = jnp.asarray(bt)
+    got = []
+    base = p
+    window = 4
+    while base < total:
+        k_eff = min(window, total - base)
+        rshape = (CFG.n_layer, 1, CFG.kv_heads, window, CFG.head_dim)
+        rk = jnp.zeros(rshape, jnp.float32)
+        rv = jnp.zeros(rshape, jnp.float32)
+        pooled = jnp.asarray([base], jnp.int32)
+        for r in range(k_eff):
+            t = base + r
+            lg, rk, rv = decode_step_paged(
+                model, tokens[:, t], jnp.asarray([t], jnp.int32),
+                pool.k, pool.v, bt, rk, rv, jnp.asarray(r, jnp.int32),
+                pooled, CFG.block_size,
+            )
+            got.append(np.asarray(lg))
+        valid = jnp.ones((1, window), bool) & (
+            jnp.arange(window)[None, :] < k_eff
+        )
+        pool = flush_recent(pool, rk, rv, bt, pooled, valid)
+        base += k_eff
+
+    for i, (a, b) in enumerate(zip(oracle, got)):
+        np.testing.assert_allclose(
+            a, b, atol=2e-4, err_msg=f"step {i} (pos {p + i})"
+        )
+
+
+def test_engine_matches_exact_sampler_per_request():
+    """Greedy engine output == the existing exact sampler, per request,
+    under mixed prompt lengths and full-batch continuous decode."""
+    model = _model()
+    prompts = _prompts(3)
+    refs = [_exact(model, p, 12) for p in prompts]
+    outs = generate_served(
+        model, prompts, 12, window=4, page_size=8, cache_dtype=jnp.float32
+    )
+    for i, (r, o) in enumerate(zip(refs, outs)):
+        np.testing.assert_array_equal(r, o, err_msg=f"request {i}")
+
+
+def test_engine_admits_mid_run_with_parity():
+    """More requests than slots: late requests are admitted mid-run as
+    slots free, and every output still matches the exact sampler."""
+    model = _model()
+    prompts = _prompts(5, base_len=4, stride=2)
+    lens = [6, 14, 9, 11, 7]  # staggered finish -> staggered admission
+    refs = [_exact(model, p, n) for p, n in zip(prompts, lens)]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, window=4, temperature=0.0,
+        cache_dtype=jnp.float32,
+    )
+    rids = [eng.submit(p, n) for p, n in zip(prompts, lens)]
+    fin = eng.run()
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    assert eng.stats()["slot_occupancy"] > 0.5
+    eng.alloc.check()
+    assert eng.alloc.held_pages == 0, "finished requests must free pages"
+
+
+def test_fused_window_matches_k1_including_eos_mid_window():
+    """K=4 fused decode reproduces the K=1 token stream exactly — with an
+    EOS landing strictly inside a window (not on its boundary), after
+    which the slot pads harmlessly to the boundary."""
+    model = _model()
+    prompt = _prompts(1)[0]
+    ref = _exact(model, prompt, 16)
+    # choose an EOS the greedy rollout actually emits at a non-boundary
+    # step (r % 4 != 3); fall back to any emitted token
+    eos, eos_pos = None, None
+    for i, t in enumerate(ref.tolist()):
+        if ref.tolist().index(t) == i and i % 4 not in (3,) and i > 0:
+            eos, eos_pos = int(t), i
+            break
+    assert eos is not None, "degenerate rollout; adjust prompt seed"
+    out_k4 = generate_served(
+        model, [prompt], 16, eos_id=eos, window=4, page_size=8,
+        cache_dtype=jnp.float32,
+    )[0]
+    out_k1 = generate_served(
+        model, [prompt], 16, eos_id=eos, window=1, page_size=8,
+        cache_dtype=jnp.float32,
+    )[0]
+    np.testing.assert_array_equal(out_k4, out_k1)
+    assert out_k4.tolist() == ref.tolist()[: eos_pos + 1], (
+        "sequence must stop at (and include) the first EOS"
+    )
+
+
+def test_engine_temperature_stream_invariant_to_window_and_slots():
+    """Categorical sampling: a request's token stream derives from
+    (seed, token-index) alone — identical across K, slot count, and batch
+    composition."""
+    model = _model()
+    prompts = _prompts(3)
+
+    def run(window, slots):
+        eng = ServingEngine(
+            model, slots=slots, page_size=8, window=window,
+            temperature=0.8, top_k=20, cache_dtype=jnp.float32, seed=3,
+        )
+        rids = [eng.submit(p, 8, seed=i) for i, p in enumerate(prompts)]
+        fin = eng.run()
+        return [fin[r].tokens for r in rids]
+
+    a = run(4, 3)
+    b = run(1, 3)
+    c = run(2, 1)  # serial slots: different batch composition entirely
+    assert a == b == c
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: scripted arrival trace, eviction, dispatch accounting
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_scripted_arrival_trace():
+    """Requests arriving between windows are admitted at the next
+    boundary; occupancy and lifecycle timestamps are recorded."""
+    model = _model()
+    prompts = _prompts(4, base_len=4, stride=1)
+    refs = [_exact(model, p, 8) for p in prompts]
+    fake_now = {"t": 0.0}
+    eng = ServingEngine(
+        model, slots=2, page_size=8, window=4, temperature=0.0,
+        cache_dtype=jnp.float32, clock=lambda: fake_now["t"],
+    )
+    # t=0: two arrivals; after the first window two more arrive
+    r0 = eng.submit(prompts[0], 8)
+    r1 = eng.submit(prompts[1], 8)
+    fake_now["t"] = 1.0
+    eng.step()
+    r2 = eng.submit(prompts[2], 8)
+    r3 = eng.submit(prompts[3], 8)
+    fin = eng.run()
+    for i, r in enumerate([r0, r1, r2, r3]):
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    # late arrivals were admitted mid-run: their TTFT clock starts at
+    # submission, and first_token_time >= submit_time for everyone
+    for r in (r0, r1, r2, r3):
+        req = fin[r]
+        assert req.first_token_time is not None
+        assert req.first_token_time >= req.submit_time
+        assert req.finish_time >= req.first_token_time
+
+
+def test_scheduler_evicts_under_page_pressure_and_recovers():
+    """A pool too small for all requests at once forces eviction; evicted
+    requests re-queue with progress kept and still finish with exact
+    parity."""
+    model = _model()
+    prompts = _prompts(4, base_len=6, stride=0)
+    refs = [_exact(model, p, 16) for p in prompts]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=5, window=4,
+        temperature=0.0, cache_dtype=jnp.float32,
+    )
+    rids = [eng.submit(p, 16) for p in prompts]
+    fin = eng.run()
+    assert eng.evictions > 0, "trace was sized to force eviction"
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    eng.alloc.check()
+    assert eng.alloc.held_pages == 0
+
+
+def test_steady_state_one_dispatch_per_k_tokens():
+    """With all slots busy and no EOS, decode runs exactly one dispatch
+    per K generated tokens per active batch."""
+    model = _model()
+    k, slots, n_new = 4, 2, 16
+    prompts = _prompts(slots, base_len=5, stride=1)
+    eng = ServingEngine(
+        model, slots=slots, page_size=8, window=k, temperature=0.0,
+        cache_dtype=jnp.float32,
+    )
+    for p in prompts:
+        eng.submit(p, n_new)
+    eng.run()
+    st = eng.stats()
+    assert st["decode_dispatches"] == n_new // k
+    assert st["tokens_generated"] == slots * n_new
+    assert st["tokens_per_dispatch"] == slots * k
+    assert st["slot_occupancy"] == 1.0
+
+
+def test_repeated_eviction_rebuilds_context_without_duplication():
+    """Regression (code review): a request evicted TWICE must rebuild its
+    admission context from the original prompt + all generated tokens —
+    appending to an already-grown prompt duplicated the first eviction's
+    tokens, corrupting the context and livelocking tight pools."""
+    model = _model()
+    prompts = _prompts(4, base_len=6, stride=0)
+    n_new = 24  # long generations -> many growth events -> re-evictions
+    refs = [_exact(model, p, n_new) for p in prompts]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=5, window=4,
+        temperature=0.0, cache_dtype=jnp.float32,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    fin = eng.run()
+    assert max(r.evictions for r in fin.values()) >= 2, (
+        "trace was sized to evict some request at least twice; got "
+        f"{[r.evictions for r in fin.values()]}"
+    )
+    for i, r in enumerate(rids):
+        # the rebuilt context is prompt0 + a PREFIX of the generated
+        # tokens (those emitted before the last eviction) — duplication
+        # would break the prefix property
+        pr = fin[r].prompt
+        np.testing.assert_array_equal(pr[: prompts[i].size], prompts[i])
+        tail = pr[prompts[i].size:]
+        np.testing.assert_array_equal(
+            tail, np.asarray(fin[r].tokens[: tail.size], np.int32),
+            err_msg=f"request {i}: context not prompt0 + generated prefix",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    eng.alloc.check()
+    assert eng.alloc.held_pages == 0
+
+
+def test_page_size_must_divide_block_size():
+    """Regression (code review): a page grid that doesn't tile block_size
+    would pad a near-block prompt past the model's context — reject at
+    construction."""
+    model = _model()
+    with pytest.raises(AssertionError):
+        ServingEngine(model, slots=1, page_size=12)  # 64 % 12 != 0
+
+
+def test_growth_capped_at_remaining_budget():
+    """Regression (code review): near end-of-generation, page growth must
+    cap at the request's remaining budget — a 60-token prompt with
+    max_new=4 exactly fills block_size=64, and demanding pages for
+    pooled_len + window tokens would ask past the request's lifetime
+    (MemoryError with one slot, spurious evictions under pressure)."""
+    model = _model()
+    prompt = _prompts(1, base_len=CFG.block_size - 4)[0]  # 60 tokens
+    ref = _exact(model, prompt, 4)
+    out = generate_served(
+        model, [prompt], 4, window=8, page_size=8, slots=1,
+        cache_dtype=jnp.float32,
+    )[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_engine_rejects_oversized_requests():
+    model = _model()
+    eng = ServingEngine(model, slots=1, page_size=8, window=2)
+    with pytest.raises(AssertionError):
+        eng.submit(np.zeros((4,), np.int32), CFG.block_size)  # no room
+    # long prompts crop to the last block_size - max_new tokens
+    long_prompt = _prompts(1, base_len=CFG.block_size + 10)[0]
+    rid = eng.submit(long_prompt, 4)
+    assert eng.queue[-1].prompt.size == CFG.block_size - 4
+    ref = _exact(model, long_prompt[-(CFG.block_size - 4):], 4)
+    fin = eng.run()
+    np.testing.assert_array_equal(np.asarray(fin[rid].tokens), ref)
+
+
+@pytest.mark.slow
+def test_decode_window_audit_donation_and_host_sync():
+    """The compiled K-step decode window passes the serving invariants:
+    pool + logits donation intact, no host round-trips inside the window
+    (the same two regressions the CI serving-audit job gates on)."""
+    from midgpt_tpu.analysis.harness import audit_decode_window
+    from midgpt_tpu.config import get_config
+
+    analysis, report = audit_decode_window(
+        get_config("shakespeare_char"), slots=2, window=4, page_size=8
+    )
+    assert report.ok, report.violations
+    assert analysis.donated_leaves == 3  # pool.k, pool.v, logits
+    assert len({e.param_number for e in analysis.aliases}) >= 3
