@@ -187,7 +187,10 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         )
 
     if args.serving:
-        from midgpt_tpu.analysis.harness import audit_decode_window
+        from midgpt_tpu.analysis.harness import (
+            audit_decode_window,
+            audit_prefill_chunk,
+        )
 
         k = args.steps_per_dispatch or 4
         analysis, report = audit_decode_window(
@@ -197,10 +200,19 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
         )
+        # the chunked-prefill steady state interleaves a prefill chunk
+        # between decode windows (its block table may alias pages shared
+        # copy-on-write with other slots): audit that program too
+        chunk_analysis, chunk_report = audit_prefill_chunk(
+            cfg,
+            page_size=args.serving_page_size,
+            shrink=not args.no_shrink,
+        )
+        ok = report.ok and chunk_report.ok
         out = {
             "config": args.config,
-            "mode": "serving-decode-window",
-            "ok": report.ok,
+            "mode": "serving-decode-window+prefill-chunk",
+            "ok": ok,
             "geometry": {
                 "slots": args.serving_slots,
                 "steps_per_dispatch": k,
@@ -211,14 +223,21 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                 ),
             },
             "rules": report.to_dict()["rules"],
+            "prefill_chunk": {
+                "donated_leaves": chunk_analysis.donated_leaves,
+                "aliased_buffers": len(
+                    {e.param_number for e in chunk_analysis.aliases}
+                ),
+                "rules": chunk_report.to_dict()["rules"],
+            },
         }
         text = json.dumps(out, indent=2)
         print(text)
         if args.json:
             with open(args.json, "w") as f:
                 f.write(text + "\n")
-        if not report.ok:
-            for v in report.violations:
+        if not ok:
+            for v in report.violations + chunk_report.violations:
                 print(f"VIOLATION {v}", file=sys.stderr)
             return 1
         return 0

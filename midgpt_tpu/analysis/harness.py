@@ -193,6 +193,40 @@ def audit_config(
     return analysis, report, cost_report(analysis)
 
 
+def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
+                         page_size: int, shrink: bool):
+    """Shared geometry for the two serving audits (decode window +
+    prefill chunk): audit-shrunk model config, 1-device mesh, bf16-cast
+    model, page pool and slot logits. ONE definition so the two compiled
+    programs can never silently audit different geometries."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving.paged import PagedKVPool, pages_needed
+
+    model_cfg = cfg.model
+    if shrink:
+        model_cfg = _dc.replace(
+            model_cfg, n_layer=2, block_size=256, vocab_size=1024,
+            remat="none", scan_unroll=1,
+        )
+    mesh = create_mesh(
+        MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1),
+        devices=jax.devices()[:1],
+    )
+    model = cast_floating(GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16)
+    pmax = pages_needed(model_cfg.block_size, page_size)
+    pool = PagedKVPool.init(model_cfg, slots * pmax, page_size)
+    logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
+    return model_cfg, mesh, model, pmax, pool, logits
+
+
 def compile_decode_window(
     cfg: ExperimentConfig,
     *,
@@ -213,40 +247,18 @@ def compile_decode_window(
     alias input->output, or every window holds two copies of the KV pool
     in HBM) and no host sync hiding inside it (one stray callback stalls
     all K decode steps per launch)."""
-    import dataclasses as _dc
-
     import jax
-    import jax.numpy as jnp
     import numpy as np_
 
-    from midgpt_tpu.config import MeshConfig
-    from midgpt_tpu.models.gpt import GPT
-    from midgpt_tpu.parallel.mesh import create_mesh
     from midgpt_tpu.serving.engine import make_decode_window
-    from midgpt_tpu.serving.paged import PagedKVPool, pages_needed
 
-    model_cfg = cfg.model
-    if shrink:
-        model_cfg = _dc.replace(
-            model_cfg, n_layer=2, block_size=256, vocab_size=1024,
-            remat="none", scan_unroll=1,
-        )
-    mesh = create_mesh(
-        MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1),
-        devices=jax.devices()[:1],
+    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
+        cfg, slots=slots, page_size=page_size, shrink=shrink
     )
-    model = GPT.init(jax.random.PRNGKey(0), model_cfg)
-    from midgpt_tpu.pytree import cast_floating
-
-    model = cast_floating(model, jnp.bfloat16)
-    pmax = pages_needed(model_cfg.block_size, page_size)
-    num_pages = slots * pmax
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
         rope_len=model_cfg.block_size,
     )
-    pool = PagedKVPool.init(model_cfg, num_pages, page_size)
-    logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = window_fn.lower(
         pool, logits, i32(slots, pmax), i32(slots),
@@ -288,6 +300,88 @@ def audit_decode_window(
         hlo,
         hlo_mod.MeshInfo.from_mesh(mesh, num_slices=1),
         global_batch=slots,
+        block=block,
+        donated_leaves=donated,
+    )
+    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
+        analysis
+    )
+    return analysis, report
+
+
+def compile_prefill_chunk(
+    cfg: ExperimentConfig,
+    *,
+    chunk_len: int = 64,
+    page_size: int = 16,
+    shrink: bool = True,
+):
+    """Compile the serving engine's prefill-chunk program
+    (``midgpt_tpu.serving.make_prefill_chunk_program``) — the suffix-only
+    prefill the prefix cache and chunked-prefill scheduler dispatch
+    between decode windows. Returns ``(hlo_text, mesh, donated_leaves,
+    audited_block_size)``.
+
+    Audited for the same serving invariants as the decode window: pool +
+    logits donation intact (under chunked prefill a chunk runs between
+    every pair of decode windows — an un-aliased pool would double KV
+    HBM on the hot path) and no host sync inside the compiled chunk. The
+    block table the chunk reads through may alias pages shared with
+    other live slots (copy-on-write guarantees they are read-only); the
+    compiled program is identical either way, which is exactly why the
+    audit covers the sharing case."""
+    import jax
+    import numpy as np_
+
+    from midgpt_tpu.serving.engine import make_prefill_chunk_program
+
+    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
+        cfg, slots=4, page_size=page_size, shrink=shrink
+    )
+    assert chunk_len <= model_cfg.block_size, (chunk_len, model_cfg.block_size)
+    chunk_fn = make_prefill_chunk_program(
+        model, chunk_len=chunk_len, pmax=pmax,
+        rope_len=model_cfg.block_size,
+    )
+    i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
+    hlo = chunk_fn.lower(
+        pool, logits, i32(), i32(1, chunk_len), i32(), i32(), i32(pmax),
+    ).compile().as_text()
+    donated_leaves = len(jax.tree.leaves((pool, logits)))
+    return hlo, mesh, donated_leaves, model_cfg.block_size
+
+
+def audit_prefill_chunk(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    chunk_len: int = 64,
+    page_size: int = 16,
+    shrink: bool = True,
+) -> tp.Tuple[StepAnalysis, Report]:
+    """One-call audit of the prefill-chunk program: donation-intact,
+    no-host-sync, no-f64 — the CI serving-audit job runs this next to
+    :func:`audit_decode_window` so a window containing a mid-window
+    prefill chunk (the chunked-prefill steady state) is covered end to
+    end."""
+    from midgpt_tpu.analysis.rules import (
+        DonationIntact,
+        NoF64,
+        NoHostSync,
+        RuleSet,
+    )
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    hlo, mesh, donated, block = compile_prefill_chunk(
+        cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink
+    )
+    analysis = StepAnalysis.from_text(
+        hlo,
+        hlo_mod.MeshInfo.from_mesh(mesh, num_slices=1),
+        global_batch=1,
         block=block,
         donated_leaves=donated,
     )

@@ -1,0 +1,84 @@
+"""Version compatibility shims.
+
+``shard_map``: jax promoted ``jax.experimental.shard_map.shard_map`` to a
+top-level ``jax.shard_map`` in newer releases, renaming ``check_rep`` ->
+``check_vma`` and replacing the complementary ``auto=`` frozenset with
+``axis_names=`` (the axes that ARE manual). This environment pins jax
+0.4.37, which only has the experimental entry point. Every call site in
+the package routes through :func:`shard_map` below, which presents the
+NEW surface and translates down when only the old one exists — so the
+code reads as current-jax and keeps working on both sides of the rename.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+import jax
+
+_HAS_TOP_LEVEL = hasattr(jax, "shard_map")
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` on any jax version (older releases call
+    the same dataclass ``TPUCompilerParams``; the fields used here —
+    dimension_semantics, vmem_limit_bytes — exist in both)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+if not _HAS_TOP_LEVEL:
+    from jax.experimental.shard_map import (  # noqa: F401
+        shard_map as _shard_map_experimental,
+    )
+
+
+def shard_map(
+    f: tp.Callable,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names: tp.Optional[tp.Collection[str]] = None,
+    check_vma: bool = True,
+) -> tp.Callable:
+    """New-style ``jax.shard_map`` surface on any jax version.
+
+    ``axis_names`` (when given) lists the MANUAL mesh axes; the old API
+    expressed the same thing as its complement ``auto=``. ``check_vma``
+    maps to the old ``check_rep`` — same replication check, renamed.
+    """
+    if _HAS_TOP_LEVEL:
+        kw: tp.Dict[str, tp.Any] = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+            **kw,
+        )
+    check_rep = check_vma
+    if axis_names is not None:
+        # Partial-manual regions run FULLY manual on the old pin: 0.4.x's
+        # experimental partial-auto lowering emits a PartitionId
+        # instruction the SPMD partitioner rejects whenever the body
+        # takes an axis_index (the PP stage id, the sharded-dropout
+        # offsets). Full-manual is value-identical — the would-be-auto
+        # axes just see their operands regathered at region entry per the
+        # in_specs — at a memory/comms cost that only exists on the old
+        # pin. The replication check predates the partial-auto semantics
+        # it would have to reason about, so it stays off here.
+        check_rep = False
+    return _shard_map_experimental(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_rep,
+    )

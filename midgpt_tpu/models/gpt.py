@@ -27,6 +27,7 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
+from midgpt_tpu.compat import shard_map
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.models.layers import (
     Embedding,
@@ -70,7 +71,7 @@ def _fused_attention_sharded(qkv, wq, wk, sin, cos, h, hkv, eps):
         fn = lambda q_, wq_, wk_, s_, c_: fused_attention_qkv(  # noqa: E731
             q_, wq_, wk_, s_, c_, h, hkv, True, eps
         )
-        return jax.shard_map(
+        return shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(data_axes), P(), P(), P(), P()),
@@ -89,7 +90,7 @@ def _fused_attention_sharded(qkv, wq, wk, sin, cos, h, hkv, eps):
         q_, k_, v_, wq_, wk_, s_, c_, h // tp, hkv // tp, True, None, None, eps
     )
     act = P(data_axes, None, "tensor")
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(act, act, act, P(), P(), P(), P()),
@@ -481,6 +482,86 @@ class Attention:
         out = out.reshape(b, h, 1, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
         return self.wo(out), rk, rv
+
+    def prefill_paged_at(
+        self,
+        x: Array,  # [1, T, D] — the prefill chunk's hidden states
+        pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+        pool_v: Array,  # [L, NP, Hkv, C, PS]
+        bt: Array,  # [1, Pmax] int32 — the slot's block table
+        layer: int,  # STATIC layer index
+        mask_pool: Array,  # [W = Pmax*PS] additive f32 (0 where pos < start)
+        mask_self: Array,  # [T, T] additive causal f32 within the chunk
+        sin_rows: Array,  # [T, C//2] rope rows at the chunk's positions
+        cos_rows: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Multi-query attention for a PREFILL CHUNK over a pre-populated
+        block table: the chunk's T tokens attend jointly to the slot's
+        already-resident pages (positions < chunk start — the cached
+        prefix and/or earlier chunks) and to themselves (causal). The
+        suffix-only prefill path of the prefix cache: a request whose
+        prompt prefix is already in the pool computes only this chunk's
+        FLOPs, and chunked prefill resumes a long prompt mid-stream from
+        whatever the block table already holds. Joint softmax over
+        [pages | chunk] — exact, same two-part discipline as
+        :meth:`decode_paged_at`. Returns (out, k, v) with k/v the chunk's
+        post-rope K / raw V [1, Hkv, T, C] for the page write.
+
+        The score/probs arithmetic deliberately MIRRORS
+        ops.attention.naive_attention op for op: compute-dtype operands
+        with f32 einsum accumulation, additive mask applied before the
+        in-softmax scale, probs cast to the value dtype before the PV
+        contraction. With an empty pool part the whole computation is
+        then bitwise what ``model.hidden`` + naive attention produces,
+        so a bf16-cache engine stays greedy-token-identical to the
+        fixed-batch sampler (a cast-to-f32-early variant drifted by ~2
+        bf16 ulps in the pool K/V — enough to flip near-tied greedy
+        argmaxes on a real checkpoint, caught by the sample.py --serve
+        verify drive)."""
+        b, t, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        qkv = self.wqkv(x)  # [1, T, (H+2Hkv)C]
+        q = qkv[..., : h * c].reshape(b, t, h, c)
+        k = qkv[..., h * c : (h + hkv) * c].reshape(b, t, hkv, c)
+        v = qkv[..., (h + hkv) * c :].reshape(b, t, hkv, c)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+            k = self.k_norm(k)
+        q = jnp.transpose(q, (0, 2, 1, 3))  # [1, H, T, C]
+        k = jnp.transpose(k, (0, 2, 1, 3))  # [1, Hkv, T, C]
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        q = apply_rotary(q, sin_rows, cos_rows)
+        k = apply_rotary(k, sin_rows, cos_rows)
+        # gather the slot's pages (clip-mode for the same NaN reason as
+        # decode_paged_at) -> logical KV [1, Hkv, C, W] in page order
+        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+        _, pmax, _, _, ps = pk_l.shape
+        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        qg = q.reshape(b, hkv, h // hkv, t, c)
+        s_pool = jnp.einsum(
+            "bhgtc,bhcw->bhgtw", qg, ck.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )  # [1, Hkv, G, T, W]
+        s_self = jnp.einsum(
+            "bhgtc,bhsc->bhgts", qg, k,
+            preferred_element_type=jnp.float32,
+        )  # [1, Hkv, G, T, T]
+        s_all = jnp.concatenate(
+            [s_pool + mask_pool, s_self + mask_self], axis=-1
+        )
+        scale = 1.0 / jnp.sqrt(c).astype(jnp.float32)
+        probs = jax.nn.softmax(s_all * scale, axis=-1)
+        probs = probs.astype(v.dtype)
+        p_pool = probs[..., : s_pool.shape[-1]]
+        p_self = probs[..., s_pool.shape[-1]:]
+        o_pool = jnp.einsum("bhgtw,bhcw->bhgtc", p_pool, cv.astype(v.dtype))
+        o_self = jnp.einsum("bhgts,bhsc->bhgtc", p_self, v)
+        out = (o_pool + o_self).reshape(b, h, t, c)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+        return self.wo(out.astype(x.dtype)), k, v
 
     def decode_recent_at(
         self,
@@ -926,6 +1007,18 @@ class Block:
         x = x + mlp_call(self.mlp, self.ln2(x))[0]
         return x, rk, rv
 
+    def prefill_paged_at(
+        self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
+        sin_rows, cos_rows,
+    ):
+        attn_out, k, v = self.attn.prefill_paged_at(
+            self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
+            sin_rows, cos_rows,
+        )
+        x = x + attn_out
+        x = x + mlp_call(self.mlp, self.ln2(x))[0]
+        return x, k, v
+
 
 def embed_tokens(wte: Embedding, tokens: Array) -> Array:
     """Token embedding that stays SPMD-friendly under tensor parallelism.
@@ -1316,6 +1409,69 @@ def decode_step_paged(
     h = model.ln_f(h)
     logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [S, V]
     return logits, rk, rv
+
+
+def prefill_chunk_paged(
+    model: GPT,
+    tokens: Array,  # [1, T] int32 — one prefill chunk (right-padded)
+    start: Array,  # [] int32 — absolute position of chunk token 0
+    pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+    pool_v: Array,
+    bt: Array,  # [1, Pmax] int32 — the slot's block table
+    rope_len: int,
+) -> tp.Tuple[Array, Array, Array]:
+    """Suffix-only prefill of one chunk against a pre-populated block
+    table: the chunk's tokens (context positions ``start .. start+T-1``)
+    attend to everything already resident in the slot's pages (positions
+    ``< start`` — the prefix-cache hit and/or earlier chunks of the same
+    prompt) plus themselves, causally, in one joint softmax per layer.
+
+    This is what makes both tentpole features exact rather than
+    approximate: a prefix-cache hit skips the cached pages' prefill
+    compute entirely (only the suffix runs through here), and chunked
+    Sarathi-style prefill resumes a long prompt mid-stream from the
+    partially-built block table — in both cases the attention each token
+    sees is identical to the monolithic full-prompt forward.
+
+    Returns ``(h, ks, vs)``: the chunk's final hidden states [1, T, D]
+    (logits come from the last REAL row) and the per-layer post-rope K /
+    raw V [L, 1, Hkv, T, C] for the page write
+    (serving.paged.write_token_rows). Pad rows beyond the chunk's real
+    length are harmless: causally invisible to real rows (they sit at
+    LATER positions) and their K/V rows are masked out of the write."""
+    cfg = model.config
+    b, t = tokens.shape
+    assert b == 1, f"chunk prefill is per-slot, got batch {b}"
+    pmax = bt.shape[1]
+    ps = pool_k.shape[-1]
+    sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
+    sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    # paged slot w of the gathered [W = Pmax*PS] view holds logical
+    # position w; resident (and < any chunk position) iff w < start
+    idx = jnp.arange(pmax * ps)
+    mask_pool = jnp.where(idx < start, 0.0, -jnp.inf).astype(jnp.float32)
+    # in-chunk causal mask; row i may attend chunk rows j <= i
+    ii = jnp.arange(t)
+    mask_self = jnp.where(
+        ii[None, :] <= ii[:, None], 0.0, -jnp.inf
+    ).astype(jnp.float32)  # [T, T]
+    pos = jnp.clip(start + ii, 0, rope_len - 1)  # pad tail clips harmlessly
+    sin_rows = jnp.take(sin_t, pos, axis=0)  # [T, C//2]
+    cos_rows = jnp.take(cos_t, pos, axis=0)
+
+    h = embed_tokens(model.wte, tokens)  # [1, T, D]
+    sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
+    ks, vs = [], []
+    for i in range(cfg.n_layer):
+        block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
+        h, k, v = block.prefill_paged_at(
+            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h
+        )
+        ks.append(k)
+        vs.append(v)
+    h = model.ln_f(h)
+    return h, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, 1, Hkv, T, C]
 
 
 def merge_recent(

@@ -20,6 +20,8 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
+from midgpt_tpu.compat import shard_map
+
 Array = jax.Array
 
 
@@ -153,14 +155,14 @@ def _flash_sharded(
                 q_, k_, v_, s_, dropout_rate, causal
             )
 
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=(spec, spec, spec, P()),
             out_specs=spec,
             axis_names=manual_axes,
         )(q, k, v, seed)
-    return jax.shard_map(
+    return shard_map(
         lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

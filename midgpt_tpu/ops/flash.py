@@ -27,6 +27,8 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+
+from midgpt_tpu.compat import tpu_compiler_params
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -128,7 +130,8 @@ def _struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
     parallel/pipeline.py:169, and the data/TP wrap in ops/attention.py) a
     plain ShapeDtypeStruct fails pallas type-checking; carrying the input
     operand's vma keeps the output varying over the same manual axes."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)  # absent (with vma) pre-0.6 jax
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -282,7 +285,7 @@ def _flash_forward(
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*operands)
@@ -496,7 +499,7 @@ def _flash_backward(
         out_specs=_act_spec(bq, c, row_q34, q_head),
         out_shape=_struct((b, h, t, c), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, c), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*seed_ops, q, k, v, do, lse, delta)
@@ -534,7 +537,7 @@ def _flash_backward(
             pltpu.VMEM((bk, c), jnp.float32),
             pltpu.VMEM((bk, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
     )(*seed_ops, q, k, v, do, lse, delta)

@@ -42,6 +42,8 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+
+from midgpt_tpu.compat import tpu_compiler_params
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -271,7 +273,7 @@ def _fused_forward(q, k, v, wq, wk, sin, cos, *, n_head, n_kv_head, causal,
             pltpu.VMEM((hpb, bq, 128), jnp.float32),
             pltpu.VMEM((hpb, bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
             # the hpb==2 bodies carry two [bq,bk] f32 temp sets; the default
             # 16M scoped-VMEM budget rejects 1024 blocks (17.03M measured)
@@ -584,7 +586,7 @@ def _fused_backward_combined(q, k, v, wq, wk, sin, cos, lse, do, out, *,
             pltpu.VMEM((t, c), jnp.float32),
             pltpu.VMEM((t, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -685,7 +687,7 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
             pltpu.VMEM((bq, lanes), jnp.float32),
             pltpu.VMEM((bq, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
             # the hpb==2 bodies carry two [bq,bk] f32 temp sets; the default
             # 16M scoped-VMEM budget rejects 1024 blocks (17.03M measured)
@@ -741,7 +743,7 @@ def _fused_backward(q, k, v, wq, wk, sin, cos, out, lse, do, *, n_head,
             pltpu.VMEM((bk, lanes), jnp.float32),
             pltpu.VMEM((bk, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
             # the hpb==2 bodies carry two [bq,bk] f32 temp sets; the default
             # 16M scoped-VMEM budget rejects 1024 blocks (17.03M measured)

@@ -35,15 +35,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from midgpt_tpu.compat import shard_map
+
 Array = jax.Array
 
 
 def _to_varying(x: Array, axis: str) -> Array:
     """Promote ``x`` to VARYING along the mesh axis. ``jax.lax.pcast``
-    replaced ``pvary`` in newer JAX; fall back so older pins keep working."""
+    replaced ``pvary`` in newer JAX; jax before ~0.5 has neither (the
+    varying-manual-axes annotation didn't exist yet), and there the
+    promotion is a value-level no-op — identity keeps old pins working."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    return x
 
 StageFn = tp.Callable[..., Array]
 """(stage_params, activation [Bm, ...][, keys [L/S, 2]]) -> activation
@@ -160,7 +166,7 @@ def pipeline_forward(
     # partial-auto: only the pipeline axis is manual; any other mesh axes
     # (replica/fsdp/sequence/tensor) stay under GSPMD, so PP composes with
     # the data/tensor shardings of the surrounding train step
-    return jax.shard_map(
+    return shard_map(
         per_stage,
         mesh=mesh,
         in_specs=in_specs,

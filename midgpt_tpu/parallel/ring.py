@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from midgpt_tpu.compat import shard_map
+
 Array = jax.Array
 
 _NEG_INF = -1e30
@@ -457,7 +459,7 @@ def ring_attention(
             )
             return _zigzag_relayout_out(out, axis_name, s)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             zigzag_body,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -495,7 +497,7 @@ def ring_attention(
                 bh_off=bh_off, n_head_total=n_head_total,
             )
 
-        fn = jax.shard_map(
+        fn = shard_map(
             drop_body,
             mesh=mesh,
             in_specs=(spec, spec, spec, P()),
@@ -503,7 +505,7 @@ def ring_attention(
             check_vma=False,
         )
         return fn(q, k, v, jnp.asarray(dropout_seed, jnp.int32).reshape(()))
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_body, axis_name=axis_name, use_flash=use_flash
         ),

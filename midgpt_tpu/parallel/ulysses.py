@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from midgpt_tpu.compat import shard_map
+
 Array = jax.Array
 
 
@@ -184,7 +186,7 @@ def ulysses_attention(
         else jnp.zeros((), jnp.int32)
     )
     manual = set(b_axes) | {axis_name}
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec, P()),

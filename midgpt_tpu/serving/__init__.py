@@ -3,15 +3,21 @@
 Public surface:
 
 - :class:`~midgpt_tpu.serving.paged.PagedKVPool`,
-  :class:`~midgpt_tpu.serving.paged.PageAllocator` — the page pool and
-  its host-side free-list allocator.
+  :class:`~midgpt_tpu.serving.paged.PageAllocator`,
+  :class:`~midgpt_tpu.serving.paged.PrefixIndex` — the page pool, its
+  host-side refcounting allocator, and the content-addressed prefix
+  index behind copy-on-write page sharing.
 - :class:`~midgpt_tpu.serving.engine.ServingEngine` — the scheduler:
   ``submit()`` requests, ``run()`` to drain, per-request
   :class:`~midgpt_tpu.serving.engine.Request` records with TTFT/latency
-  timestamps.
-- :func:`~midgpt_tpu.serving.engine.make_decode_window` — the fused
-  K-step decode program (also what the analysis CLI audits for donation
-  and host-sync regressions: ``python -m midgpt_tpu.analysis --serving``).
+  timestamps. ``prefix_cache=True`` shares already-resident pages across
+  requests (prefill skips the cached prefix); ``prefill_chunk=N``
+  prefills Sarathi-style in N-token chunks interleaved with decode.
+- :func:`~midgpt_tpu.serving.engine.make_decode_window`,
+  :func:`~midgpt_tpu.serving.engine.make_prefill_chunk_program` — the
+  fused K-step decode program and the suffix-prefill chunk program
+  (both audited for donation and host-sync regressions:
+  ``python -m midgpt_tpu.analysis --serving``).
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -25,28 +31,36 @@ import numpy as np
 from midgpt_tpu.serving.engine import (
     Request,
     ServingEngine,
+    make_copy_page_program,
     make_decode_window,
-    make_prefill_program,
+    make_prefill_chunk_program,
 )
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
+    PrefixIndex,
+    copy_page,
     flush_recent,
     pages_needed,
     write_prompt_pages,
+    write_token_rows,
 )
 
 __all__ = [
     "PageAllocator",
     "PagedKVPool",
+    "PrefixIndex",
     "Request",
     "ServingEngine",
+    "copy_page",
     "flush_recent",
     "generate_served",
+    "make_copy_page_program",
     "make_decode_window",
-    "make_prefill_program",
+    "make_prefill_chunk_program",
     "pages_needed",
     "write_prompt_pages",
+    "write_token_rows",
 ]
 
 
@@ -63,6 +77,9 @@ def generate_served(
     page_size: int = 16,
     cache_dtype=None,
     seed: int = 0,
+    prefix_cache: bool = True,
+    prefill_chunk: tp.Optional[int] = None,
+    prefill_budget: tp.Optional[int] = None,
     mesh=None,
 ) -> tp.List[np.ndarray]:
     """One-shot batch generation routed through the serving engine: submit
@@ -81,6 +98,9 @@ def generate_served(
         top_k=top_k,
         cache_dtype=cache_dtype if cache_dtype is not None else jnp.bfloat16,
         seed=seed,
+        prefix_cache=prefix_cache,
+        prefill_chunk=prefill_chunk,
+        prefill_budget=prefill_budget,
         mesh=mesh,
     )
     rids = [

@@ -8,6 +8,19 @@ PERF.md r5) once per generated token. This engine replaces both:
 
 - **Paged KV** (serving.paged): requests own page lists in a shared pool,
   so admission is a page allocation, eviction a free — no cache reshapes.
+- **Prefix caching with copy-on-write sharing** (serving.paged): pages
+  are refcounted and full pages are content-indexed by their token
+  prefix chain; a new request's block table points straight at already-
+  resident pages of any live or finished request, its prefill computes
+  only the uncached suffix, and the one partially-shared page is copied
+  before the request may append (never two writers on a page). Finished
+  requests' pages go COLD (refcount 0, still resident) and serve future
+  hits until page pressure reclaims them, LRU, leaves first.
+- **Chunked prefill** (Sarathi-style): long prompts prefill in fixed-
+  token-budget chunks interleaved between the fused decode windows
+  instead of monopolizing one, bounding TTFT for co-scheduled requests;
+  a chunk resumes mid-prompt from the partially-built block table
+  (models.gpt.prefill_chunk_paged), so chunking is exact, not windowed.
 - **Continuous batching**: a host-side scheduler admits queued requests
   into free decode slots at every window boundary, interleaves their
   prefills with decode, and evicts (re-queues with progress kept) under
@@ -23,15 +36,14 @@ PERF.md r5) once per generated token. This engine replaces both:
 Determinism contract: per-request sampling keys derive from
 ``fold_in(fold_in(key, request_seed), tokens_emitted_so_far)`` — the token
 stream of a request is a function of the request alone, independent of
-which slot it lands in, the window size K, batch composition, and any
-mid-run eviction/re-admission.
+which slot it lands in, the window size K, batch composition, any
+mid-run eviction/re-admission, prefix-cache hits, and prefill chunking.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
-import math
 import time
 import typing as tp
 
@@ -39,13 +51,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from midgpt_tpu.models.gpt import GPT, decode_step_paged
+from midgpt_tpu.models.gpt import (
+    GPT,
+    decode_step_paged,
+    prefill_chunk_paged,
+)
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
+    PrefixIndex,
+    copy_page,
     flush_recent,
     pages_needed,
-    write_prompt_pages,
+    write_token_rows,
 )
 
 Array = jax.Array
@@ -81,7 +99,9 @@ def make_decode_window(
 
     Finished/empty slots ride along masked: they sample pad, their page
     writes route to the drop sentinel, and their emissions are masked out
-    host-side — the scan shape never depends on traffic.
+    host-side — the scan shape never depends on traffic. Slots still
+    mid-prefill ride the same way (``done`` carries them), so chunked
+    prefill and decode interleave without a second program shape.
     """
     from midgpt_tpu.parallel.sharding import axis_rules
     from midgpt_tpu.sampling import _sample_token
@@ -165,37 +185,44 @@ def make_decode_window(
     return jax.jit(window_fn, donate_argnums=(0, 1))
 
 
-def make_prefill_program(model: GPT, *, prompt_len: int, mesh=None):
-    """A prefill program for one padded prompt length: one batched forward
-    collecting per-layer K/V (models.gpt prefill path), a bulk page write,
-    and the admitted slot's logits row updated in place. One compile per
-    padded length — the engine buckets prompts to powers-of-two page
-    counts to bound recompiles."""
+def make_prefill_chunk_program(
+    model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None
+):
+    """A prefill-chunk program for one padded chunk length: one forward
+    over the chunk's tokens attending to the slot's already-resident
+    pages (models.gpt.prefill_chunk_paged), a token-granular bulk page
+    scatter, and the slot's logits row updated from the chunk's last
+    real token — so the FINAL chunk of a prompt leaves exactly the
+    logits a monolithic prefill would. Pool and logits are donated (the
+    audit gates on it: a chunk runs between every pair of decode windows
+    under chunked prefill, and an un-aliased pool would double KV HBM on
+    the serving hot path). One compile per padded chunk length — the
+    engine buckets chunks to powers-of-two page counts, and fixed-size
+    chunking hits a single bucket in steady state."""
     from midgpt_tpu.parallel.sharding import axis_rules
 
     cfg = model.config
-    assert prompt_len <= cfg.block_size, (prompt_len, cfg.block_size)
-    impl = (
-        "auto"
-        if cfg.attn_impl in ("ring", "ulysses", "flash", "fused")
-        else cfg.attn_impl
-    )
+    assert chunk_len <= cfg.block_size, (chunk_len, cfg.block_size)
 
-    def prefill_fn(
+    def chunk_fn(
         pool: PagedKVPool,  # DONATED
         logits: Array,  # [S, V] DONATED
-        slot: Array,  # [] int32 — the admitted slot
-        tokens: Array,  # [1, prompt_len] int32 (right-padded)
-        real_len: Array,  # [] int32
-        page_rows: Array,  # [prompt_len // page_size] int32 (pad = sentinel)
+        slot: Array,  # [] int32 — the prefilling slot
+        tokens: Array,  # [1, chunk_len] int32 (right-padded)
+        start: Array,  # [] int32 — absolute position of chunk token 0
+        real_n: Array,  # [] int32 — real tokens in this chunk
+        bt_row: Array,  # [pmax] int32 — the slot's block table
     ):
         with axis_rules(mesh):
-            h, (ks, vs) = model.hidden(
-                tokens, deterministic=True, attn_impl=impl, return_kv=True
-            )  # ks/vs: [L, 1, Hkv, P, C]
-            pool = write_prompt_pages(pool, ks[:, 0], vs[:, 0], page_rows)
+            h, ks, vs = prefill_chunk_paged(
+                model, tokens, start, pool.k, pool.v, bt_row[None, :],
+                rope_len,
+            )  # h: [1, T, D]; ks/vs: [L, 1, Hkv, T, C]
+            pool = write_token_rows(
+                pool, ks[:, 0], vs[:, 0], bt_row, start, real_n
+            )
             h_last = jax.lax.dynamic_slice_in_dim(
-                h, real_len - 1, 1, axis=1
+                h, real_n - 1, 1, axis=1
             )[:, 0]  # [1, D]
             row = (h_last @ model.head_weight(h_last.dtype)).astype(
                 logits.dtype
@@ -205,7 +232,15 @@ def make_prefill_program(model: GPT, *, prompt_len: int, mesh=None):
             )
         return pool, logits
 
-    return jax.jit(prefill_fn, donate_argnums=(0, 1))
+    return jax.jit(chunk_fn, donate_argnums=(0, 1))
+
+
+def make_copy_page_program():
+    """The jitted copy-on-write primitive: duplicate one page so an
+    admission landing on a partially-shared cached page gets a private
+    copy to append into. Pool donated — the copy is in-place up to the
+    one written page row."""
+    return jax.jit(copy_page, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +268,8 @@ class Request:
     finish_time: tp.Optional[float] = None
     tokens: tp.List[int] = dataclasses.field(default_factory=list)
     evictions: int = 0
+    cached_tokens: int = 0  # prompt tokens served from the prefix cache
+    # (summed over admissions — re-admissions typically re-hit)
 
     @property
     def done(self) -> bool:
@@ -242,12 +279,29 @@ class Request:
 class ServingEngine:
     """Continuous-batching scheduler over ``slots`` decode lanes.
 
-    Every :meth:`step` is one scheduler window: admit queued requests into
-    free slots (prefill + page allocation), top up page allocations for
-    the coming K tokens (evicting the youngest request under pressure —
-    its progress is kept and it re-queues with prompt+generated), launch
-    ONE fused K-step decode dispatch for all slots, then harvest emitted
-    tokens / finished requests with a single device->host read.
+    Every :meth:`step` is one scheduler window: admit queued requests
+    into free slots (prefix-cache match + page allocation), run up to
+    ``prefill_budget`` tokens of pending prefill chunks, top up page
+    allocations for the coming K tokens (evicting the youngest request
+    under pressure — its progress is kept and it re-queues with
+    prompt+generated), launch ONE fused K-step decode dispatch for all
+    decoding slots, then harvest emitted tokens / finished requests with
+    a single device->host read.
+
+    Prefix cache (``prefix_cache=True``): full pages are registered in a
+    host-side content index as they fill; admission points the block
+    table at matched pages (skipping their prefill compute entirely),
+    copies the one partially-matched page (COW), and computes only the
+    suffix — always at least the last prompt token, which is what
+    produces the first decode logits. Finished requests' pages stay
+    resident cold until page pressure reclaims them LRU. Token streams
+    are identical with the cache on or off.
+
+    Chunked prefill (``prefill_chunk=N``): prompts prefill N tokens at a
+    time, at most ``prefill_budget`` tokens between consecutive decode
+    windows, so a long prompt cannot stall co-scheduled decode slots for
+    more than one chunk. ``prefill_chunk=None`` keeps the monolithic
+    behavior (the whole uncached suffix in one dispatch).
 
     Capacity contract: a request must fit its context in ``block_size``
     (prompts are cropped to ``block_size - max_new_tokens`` like the
@@ -268,6 +322,9 @@ class ServingEngine:
         pad_id: int = 0,
         seed: int = 0,
         max_prefills_per_window: tp.Optional[int] = None,
+        prefix_cache: bool = True,
+        prefill_chunk: tp.Optional[int] = None,
+        prefill_budget: tp.Optional[int] = None,
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
     ):
@@ -279,6 +336,7 @@ class ServingEngine:
         assert cfg.block_size % page_size == 0, (
             f"page_size {page_size} must divide block_size {cfg.block_size}"
         )
+        assert prefill_chunk is None or prefill_chunk >= 1
         self.model = model
         self.slots = slots
         self.window = window
@@ -290,6 +348,17 @@ class ServingEngine:
         if num_pages is None:
             num_pages = slots * self.pmax  # full occupancy, no eviction
         self.alloc = PageAllocator(num_pages)
+        self.prefix_cache = prefix_cache
+        self.index = PrefixIndex(page_size) if prefix_cache else None
+        self.prefill_chunk = prefill_chunk
+        # tokens of prefill work allowed between decode windows; the
+        # first chunk always runs (progress guarantee), so the effective
+        # floor is one chunk
+        self.prefill_budget = (
+            prefill_budget
+            if prefill_budget is not None
+            else prefill_chunk  # None (monolithic) -> unlimited
+        )
         self.pool = PagedKVPool.init(cfg, num_pages, page_size, cache_dtype)
         self.logits = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
         self._key = jax.random.PRNGKey(seed)
@@ -305,12 +374,29 @@ class ServingEngine:
         self.bt = np.full((slots, self.pmax), self._sentinel, np.int32)
         self.pooled_len = np.zeros((slots,), np.int32)
         self.done = np.ones((slots,), bool)  # empty slots ride as done
+        self.prefilling = np.zeros((slots,), bool)
         self.emitted = np.zeros((slots,), np.int32)
         self.budget = np.zeros((slots,), np.int32)
         self.eos = np.full((slots,), -1, np.int32)
         self.seeds = np.zeros((slots,), np.int32)
         self.slot_pages: tp.List[tp.List[int]] = [[] for _ in range(slots)]
         self.slot_req: tp.List[tp.Optional[Request]] = [None] * slots
+        # the slot's context tokens (prompt + generated) — what its page
+        # contents encode; drives content registration in the index
+        self.slot_ctx: tp.List[tp.List[int]] = [[] for _ in range(slots)]
+        # pages of the slot already walked for registration (matched
+        # pages count: they were indexed before admission)
+        self.slot_registered: tp.List[int] = [0] * slots
+        # the index node (page id, -1 = root) the slot's chain is at
+        self.slot_node: tp.List[int] = [PrefixIndex._ROOT] * slots
+        # extra refcounts the slot holds on CANONICAL pages it chains
+        # through without owning (register() returned someone else's
+        # identical-content page): pinned so LRU reclaim can never leave
+        # slot_node/parent ids dangling in the index
+        self.slot_pins: tp.List[tp.List[int]] = [[] for _ in range(slots)]
+        # round-robin cursor over prefilling slots (persists across
+        # windows so a one-chunk budget still alternates slots)
+        self._prefill_rr = 0
 
         self.queue: tp.Deque[Request] = collections.deque()
         self.finished: tp.Dict[int, Request] = {}
@@ -327,15 +413,21 @@ class ServingEngine:
             top_k=top_k,
             mesh=mesh,
         )
-        self._prefill_fns: tp.Dict[int, tp.Any] = {}
+        self._chunk_fns: tp.Dict[int, tp.Any] = {}
+        self._copy_fn = make_copy_page_program()
 
         # counters (bench_serving / tests)
         self.decode_dispatches = 0
         self.prefill_dispatches = 0
+        self.copy_dispatches = 0
         self.tokens_generated = 0
         self.windows = 0
         self.occupancy_sum = 0
         self.evictions = 0
+        self.prompt_tokens_total = 0
+        self.prompt_tokens_cached = 0
+        self.prefill_tokens_computed = 0
+        self.cold_reclaims = 0
 
     # -- submission ---------------------------------------------------------
 
@@ -386,12 +478,49 @@ class ServingEngine:
     def _active_slots(self) -> tp.List[int]:
         return [s for s in range(self.slots) if self.slot_req[s] is not None]
 
+    def _decoding_slots(self) -> tp.List[int]:
+        return [
+            s
+            for s in range(self.slots)
+            if self.slot_req[s] is not None and not self.prefilling[s]
+        ]
+
     def _prefill_bucket(self, p: int) -> int:
-        """Padded prompt length: pages rounded up to a power of two, so the
+        """Padded chunk length: pages rounded up to a power of two, so the
         number of compiled prefill programs is O(log(block/page_size))."""
         n = pages_needed(p, self.page_size)
         n = 1 << (n - 1).bit_length()
         return min(n * self.page_size, self.pmax * self.page_size)
+
+    # -- page accounting with cold-cache spill ------------------------------
+
+    def _try_reserve(self, n: int) -> bool:
+        """Make ``n`` pages allocatable, reclaiming cold cached prefixes
+        LRU-leaf-first under pressure; False when the pool genuinely
+        cannot produce them. refcount>0 pages are never touched, which is
+        why callers PIN (incref) any matched chain before reserving —
+        attempt-based rather than counting-based, because a cold page is
+        only reclaimable once no held page chains through it."""
+        while not self.alloc.can_alloc(n):
+            victim = (
+                self.index.evict_cold_leaf() if self.index is not None
+                else None
+            )
+            if victim is None:
+                return False
+            self.alloc.reclaim(victim)
+            self.cold_reclaims += 1
+        return True
+
+    def _release_pages(self, pages: tp.Iterable[int]) -> None:
+        """Decref a request's pages: indexed ones retire to the cold
+        prefix cache (still matchable), private ones free outright."""
+        for p in pages:
+            cached = self.index is not None and p in self.index
+            if self.alloc.decref(p, cache=cached) == 0 and cached:
+                self.index.touch_cold(p)
+
+    # -- admission ----------------------------------------------------------
 
     def _admit(self) -> None:
         admitted = 0
@@ -402,54 +531,188 @@ class ServingEngine:
                 continue
             req = self.queue[0]
             p = int(req.prompt.size)
-            n_pages = pages_needed(p, self.page_size)
-            if not self.alloc.can_alloc(n_pages):
-                break  # head-of-line blocks: pages free up as requests end
+            # prefix-cache match, capped at p-1: the last prompt token is
+            # ALWAYS recomputed — its forward pass is what produces the
+            # first decode logits (and, page-granularly, guarantees the
+            # slot's append page is never a shared one)
+            full: tp.List[int] = []
+            cow_src: tp.Optional[int] = None
+            matched = 0
+            if self.index is not None:
+                full, cow_src, matched = self.index.match(req.prompt[: p - 1])
+            # PIN the matched chain (and the COW source, until its copy
+            # lands) before reserving: revived out of the LRU, the
+            # reservation below can never reclaim them out from under us
+            pinned = list(full) + ([cow_src] if cow_src is not None else [])
+            for pg in pinned:
+                self.alloc.incref(pg)
+                self.index.revive(pg)
+            need = pages_needed(p, self.page_size) - len(full)
+            if not self._try_reserve(need):
+                # head-of-line blocks: unpin and wait for pages to free
+                self._release_pages(pinned)
+                break
             self.queue.popleft()
-            pages = self.alloc.alloc(n_pages)
-            bucket = self._prefill_bucket(p)
-            toks = np.full((1, bucket), self.pad_id, np.int32)
-            toks[0, :p] = req.prompt
-            rows = np.full((bucket // self.page_size,), self._sentinel,
-                           np.int32)
-            rows[:n_pages] = pages
-            if bucket not in self._prefill_fns:
-                self._prefill_fns[bucket] = make_prefill_program(
-                    self.model, prompt_len=bucket, mesh=self._mesh
+            fresh = self.alloc.alloc(need)
+            pages = full + fresh
+            if cow_src is not None:
+                # fresh[0] becomes the private copy-on-write page holding
+                # the partial tail of the matched prefix
+                dst = fresh[0]
+                self.pool = self._copy_fn(
+                    self.pool,
+                    jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32),
                 )
-            self.pool, self.logits = self._prefill_fns[bucket](
-                self.pool,
-                self.logits,
-                jnp.asarray(s, jnp.int32),
-                jnp.asarray(toks),
-                jnp.asarray(p, jnp.int32),
-                jnp.asarray(rows),
-            )
-            self.prefill_dispatches += 1
+                self.copy_dispatches += 1
+                self._release_pages([cow_src])  # back to cold (or shared)
+            n_pages = len(pages)
             self.slot_req[s] = req
             self.slot_pages[s] = list(pages)
             self.bt[s, :] = self._sentinel
             self.bt[s, :n_pages] = pages
-            self.pooled_len[s] = p
-            self.done[s] = False
+            self.pooled_len[s] = matched
+            self.done[s] = True  # not decodable until prefill completes
+            self.prefilling[s] = True
             self.emitted[s] = len(req.tokens)
             self.budget[s] = req.max_new_tokens
             self.eos[s] = req.eos_id
             self.seeds[s] = req.seed
+            self.slot_ctx[s] = [int(t) for t in req.prompt]
+            self.slot_registered[s] = len(full)
+            self.slot_node[s] = full[-1] if full else PrefixIndex._ROOT
+            self.prompt_tokens_total += p
+            self.prompt_tokens_cached += matched
+            req.cached_tokens += matched
             admitted += 1
 
+    # -- chunked prefill ----------------------------------------------------
+
+    def _prefill_one_chunk(self, s: int) -> bool:
+        """Run ONE prefill chunk for slot ``s``; returns True when the
+        slot's prompt is fully resident (the slot becomes decodable)."""
+        req = self.slot_req[s]
+        assert req is not None and self.prefilling[s]
+        p = len(self.slot_ctx[s])  # == req.prompt.size at admission
+        start = int(self.pooled_len[s])
+        remaining = p - start
+        assert remaining >= 1, (s, p, start)
+        clen = (
+            remaining
+            if self.prefill_chunk is None
+            else min(self.prefill_chunk, remaining)
+        )
+        bucket = self._prefill_bucket(clen)
+        toks = np.full((1, bucket), self.pad_id, np.int32)
+        toks[0, :clen] = req.prompt[start : start + clen]
+        if bucket not in self._chunk_fns:
+            self._chunk_fns[bucket] = make_prefill_chunk_program(
+                self.model,
+                chunk_len=bucket,
+                pmax=self.pmax,
+                rope_len=self.block,
+                mesh=self._mesh,
+            )
+        self.pool, self.logits = self._chunk_fns[bucket](
+            self.pool,
+            self.logits,
+            jnp.asarray(s, jnp.int32),
+            jnp.asarray(toks),
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(clen, jnp.int32),
+            jnp.asarray(self.bt[s]),
+        )
+        self.prefill_dispatches += 1
+        self.prefill_tokens_computed += clen
+        self.pooled_len[s] = start + clen
+        self._register_pages(s)
+        if start + clen >= p:
+            self.prefilling[s] = False
+            self.done[s] = False  # decodable from the next window on
+            return True
+        return False
+
+    def _run_prefills(self) -> None:
+        """Sarathi-style chunk scheduling: round-robin one chunk per
+        prefilling slot until the per-window token budget is spent (the
+        first chunk always runs, so prefill can never starve). The
+        rotation cursor persists ACROSS windows — with the default
+        one-chunk budget, restarting at slot 0 every window would feed
+        slot 0's whole prompt before a second prefilling slot saw its
+        first chunk, exactly the TTFT starvation chunking exists to
+        bound."""
+        spent = 0
+        while True:
+            pending = [s for s in range(self.slots) if self.prefilling[s]]
+            if not pending:
+                return
+            pending.sort(
+                key=lambda s: (s - self._prefill_rr) % self.slots
+            )
+            for s in pending:
+                if not self.prefilling[s]:
+                    continue
+                before = self.prefill_tokens_computed
+                self._prefill_one_chunk(s)
+                self._prefill_rr = (s + 1) % self.slots
+                spent += self.prefill_tokens_computed - before
+                if self.prefill_budget is not None and (
+                    spent >= self.prefill_budget
+                ):
+                    return
+
+    # -- prefix-index registration ------------------------------------------
+
+    def _register_pages(self, s: int) -> None:
+        """Index every newly-FULL page of slot ``s`` by its content chain.
+        Full pages are immutable (append-only pool), so once indexed they
+        may be aliased into any other block table."""
+        if self.index is None:
+            return
+        ps = self.page_size
+        ctx = self.slot_ctx[s]
+        resident = int(self.pooled_len[s])
+        while (self.slot_registered[s] + 1) * ps <= resident:
+            i = self.slot_registered[s]
+            page = int(self.bt[s, i])
+            chunk = ctx[i * ps : (i + 1) * ps]
+            canonical = self.index.register(self.slot_node[s], chunk, page)
+            if canonical != page:
+                # identical content was indexed first by someone else: our
+                # page stays private (freed, not cached, at release) and
+                # the chain continues through the canonical id — which we
+                # must PIN (we hold no ref on it via slot_pages), or cold
+                # LRU reclaim could free it while it is still this slot's
+                # chain parent, leaving a dangling id in the index
+                self.alloc.incref(canonical)
+                self.index.revive(canonical)
+                self.slot_pins[s].append(canonical)
+            self.slot_node[s] = canonical
+            self.slot_registered[s] += 1
+
+    # -- release / eviction -------------------------------------------------
+
     def _release_slot(self, s: int) -> None:
-        self.alloc.free(self.slot_pages[s])
+        self._release_pages(self.slot_pages[s])
+        self._release_pages(self.slot_pins[s])
         self.slot_pages[s] = []
+        self.slot_pins[s] = []
         self.slot_req[s] = None
         self.bt[s, :] = self._sentinel
         self.pooled_len[s] = 0
         self.done[s] = True
+        self.prefilling[s] = False
+        self.slot_ctx[s] = []
+        self.slot_registered[s] = 0
+        self.slot_node[s] = PrefixIndex._ROOT
 
     def _evict(self, s: int) -> None:
         """Preempt slot ``s``: keep its progress (prompt grows by the
         generated tokens, budget shrinks to the remainder) and re-queue it
-        at the FRONT so it resumes as soon as pages free up."""
+        at the FRONT so it resumes as soon as pages free up. Its pages
+        retire to the cold prefix cache, so re-admission typically
+        re-prefills via cache hits — same tokens, a fraction of the
+        FLOPs, and still bit-identical."""
         req = self.slot_req[s]
         assert req is not None
         # rebuild from the ORIGINAL prompt (a second eviction appending to
@@ -466,10 +729,10 @@ class ServingEngine:
         self.evictions += 1
 
     def _ensure_growth(self) -> None:
-        """Before the window, every active slot needs pages for up to K
-        more tokens; allocate on demand, evicting the youngest slot (by
+        """Before the window, every decoding slot needs pages for up to K
+        more tokens; allocate on demand, evicting the youngest request (by
         admission recency ~ least progress) under pool pressure."""
-        for s in self._active_slots():
+        for s in self._decoding_slots():
             if self.slot_req[s] is None:
                 continue  # evicted by an earlier slot's pressure this pass
             # growth is capped at the request's REMAINING budget, not the
@@ -482,7 +745,7 @@ class ServingEngine:
             need = min(
                 pages_needed(tokens, self.page_size), self.pmax
             ) - len(self.slot_pages[s])
-            while need > 0 and not self.alloc.can_alloc(need):
+            while need > 0 and not self._try_reserve(need):
                 others = [v for v in self._active_slots() if v != s]
                 if not others:
                     raise MemoryError(
@@ -499,11 +762,15 @@ class ServingEngine:
     def step(self) -> bool:
         """One scheduler window. Returns True while there is (or was) work."""
         self._admit()
-        active = self._active_slots()
-        if not active:
-            return bool(self.queue)
+        self._run_prefills()
+        decoding = self._decoding_slots()
+        if not decoding:
+            # progress was prefill-only (or nothing runnable yet)
+            return bool(self.queue) or bool(self._active_slots())
         self._ensure_growth()
-        active = self._active_slots()  # eviction may have changed it
+        decoding = self._decoding_slots()  # eviction may have changed it
+        if not decoding:
+            return True
 
         (
             self.pool, self.logits, toks, emit, done_d, new_len, emitted_d
@@ -521,7 +788,7 @@ class ServingEngine:
         )
         self.decode_dispatches += 1
         self.windows += 1
-        self.occupancy_sum += len(active)
+        self.occupancy_sum += len(decoding)
 
         # ONE device->host sync per window: the stacked [K, S] outputs
         toks_h = np.asarray(toks)
@@ -532,19 +799,80 @@ class ServingEngine:
         self.pooled_len = np.array(new_len, np.int32)
         self.emitted = np.array(emitted_d, np.int32)
         now = self.clock()
-        for s in active:
+        for s in decoding:
             req = self.slot_req[s]
             new = [int(t) for r in range(self.window)
                    for t in [toks_h[r, s]] if emit_h[r, s]]
             if new and req.first_token_time is None:
                 req.first_token_time = now
             req.tokens.extend(new)
+            self.slot_ctx[s].extend(new)
             self.tokens_generated += len(new)
+            # generated tokens fill pages too — register them so shared-
+            # context traffic (multi-turn chat) hits on earlier turns
+            self._register_pages(s)
             if self.done[s]:
                 req.finish_time = now
                 self.finished[req.rid] = req
                 self._release_slot(s)
         return True
+
+    def warm_prefill(self, max_tokens: int) -> tp.List[int]:
+        """Pre-compile every prefill-chunk bucket a trace of prompts up
+        to ``max_tokens`` (suffix) tokens can dispatch — all powers-of-
+        two page counts up to the largest single chunk. With the prefix
+        cache on, admissions prefill arbitrary SUFFIX lengths (and
+        chunking caps them at ``prefill_chunk``), so warming only the
+        full-prompt buckets leaves compiles inside the measured region
+        on exactly the cache-hit/chunked paths. Each bucket runs one
+        pad-token no-op chunk: an all-sentinel block table drops the
+        page writes, and the engine must be idle (slot 0's logits row is
+        scratch). Returns the warmed bucket lengths."""
+        assert not self._active_slots(), "warm_prefill needs an idle engine"
+        cap = min(
+            max_tokens
+            if self.prefill_chunk is None
+            else min(self.prefill_chunk, max_tokens),
+            self.pmax * self.page_size,
+        )
+        buckets = sorted(
+            {self._prefill_bucket(n) for n in range(1, cap + 1)}
+        )
+        sentinel_row = jnp.full((self.pmax,), self._sentinel, jnp.int32)
+        for b in buckets:
+            if b not in self._chunk_fns:
+                self._chunk_fns[b] = make_prefill_chunk_program(
+                    self.model,
+                    chunk_len=b,
+                    pmax=self.pmax,
+                    rope_len=self.block,
+                    mesh=self._mesh,
+                )
+            self.pool, self.logits = self._chunk_fns[b](
+                self.pool,
+                self.logits,
+                jnp.asarray(0, jnp.int32),
+                jnp.full((1, b), self.pad_id, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(b, jnp.int32),
+                sentinel_row,
+            )
+        return buckets
+
+    def clear_prefix_cache(self) -> int:
+        """Reclaim every COLD cached page (refcount-0 resident prefixes);
+        returns the count. Live slots' pages are untouched. Benchmarks
+        call this after warmup so measured hit rates come from the
+        measured trace alone."""
+        n = 0
+        if self.index is None:
+            return n
+        while True:
+            victim = self.index.evict_cold_leaf()
+            if victim is None:
+                return n
+            self.alloc.reclaim(victim)
+            n += 1
 
     def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
         """Drive :meth:`step` until queue and slots drain; returns the
@@ -564,11 +892,21 @@ class ServingEngine:
         return {
             "decode_dispatches": self.decode_dispatches,
             "prefill_dispatches": self.prefill_dispatches,
+            "copy_dispatches": self.copy_dispatches,
             "tokens_generated": self.tokens_generated,
             "windows": self.windows,
             "slot_occupancy": round(occ, 4),
             "evictions": self.evictions,
             "free_pages": self.alloc.free_pages,
+            "cached_pages": self.alloc.cached_pages,
+            "cold_reclaims": self.cold_reclaims,
+            "prompt_tokens_total": self.prompt_tokens_total,
+            "prefill_tokens_saved": self.prompt_tokens_cached,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "prefix_hit_rate": round(
+                self.prompt_tokens_cached / max(1, self.prompt_tokens_total),
+                4,
+            ),
             "tokens_per_dispatch": round(
                 self.tokens_generated / max(1, self.decode_dispatches), 2
             ),

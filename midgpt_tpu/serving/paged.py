@@ -26,6 +26,7 @@ window, never inside jit.
 
 from __future__ import annotations
 
+import collections
 import typing as tp
 
 import jax
@@ -64,19 +65,29 @@ class PagedKVPool:
 
 
 class PageAllocator:
-    """Host-side free-list allocator over pool page ids.
+    """Host-side refcounting allocator over pool page ids.
 
-    Invariants (tested): a page is held by at most one owner; ``free +
-    held == num_pages`` at all times; double-free and foreign-free raise.
-    Allocation is LIFO so a request that frees and re-allocates under
-    light load reuses hot pages (better HBM locality than FIFO cycling
-    through the whole pool)."""
+    A page is in exactly one of three states:
+
+    - **free** — on the free list, contents meaningless;
+    - **held** — refcount >= 1: referenced by one or more live requests
+      (prefix sharing is an :meth:`incref`, not a second owner);
+    - **cached** — refcount 0 but still resident: a cold prefix-cache
+      page whose KV is kept for future hits until page pressure reclaims
+      it (:meth:`reclaim`). Never written while cached.
+
+    Invariants (tested): ``free + held + cached == num_pages``; a
+    refcount is never negative (decref of a free/cached page raises);
+    double-free and foreign-free raise. Allocation is LIFO so a request
+    that frees and re-allocates under light load reuses hot pages
+    (better HBM locality than FIFO cycling through the whole pool)."""
 
     def __init__(self, num_pages: int):
         assert num_pages >= 1, num_pages
         self.num_pages = num_pages
         self._free: tp.List[int] = list(range(num_pages - 1, -1, -1))
-        self._held: tp.Set[int] = set()
+        self._ref: tp.Dict[int, int] = {}
+        self._cached: tp.Set[int] = set()
 
     @property
     def free_pages(self) -> int:
@@ -84,14 +95,22 @@ class PageAllocator:
 
     @property
     def held_pages(self) -> int:
-        return len(self._held)
+        return len(self._ref)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._cached)
+
+    def refcount(self, p: int) -> int:
+        return self._ref.get(p, 0)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> tp.List[int]:
-        """Pop ``n`` pages off the free list; raises MemoryError when the
-        pool can't satisfy the request (the scheduler's cue to evict)."""
+        """Pop ``n`` pages off the free list at refcount 1; raises
+        MemoryError when the pool can't satisfy the request (the
+        scheduler's cue to reclaim cold cache pages, then evict)."""
         assert n >= 0, n
         if n > len(self._free):
             raise MemoryError(
@@ -99,22 +118,217 @@ class PageAllocator:
                 f"of {self.num_pages}"
             )
         pages = [self._free.pop() for _ in range(n)]
-        self._held.update(pages)
+        self._ref.update((p, 1) for p in pages)
         return pages
 
+    def incref(self, p: int) -> None:
+        """Share a page: held -> refcount + 1, or revive a cold cached
+        page to refcount 1 (a prefix-cache hit)."""
+        if p in self._cached:
+            self._cached.remove(p)
+            self._ref[p] = 1
+        elif p in self._ref:
+            self._ref[p] += 1
+        else:
+            raise ValueError(f"incref of free page {p}")
+
+    def decref(self, p: int, cache: bool = False) -> int:
+        """Drop one reference; returns the new refcount. At zero the page
+        leaves the held set — to the cold cache when ``cache`` (the
+        prefix index still maps its contents) else to the free list."""
+        if p not in self._ref:
+            raise ValueError(f"freeing page {p} that is not held")
+        self._ref[p] -= 1
+        n = self._ref[p]
+        if n == 0:
+            del self._ref[p]
+            if cache:
+                self._cached.add(p)
+            else:
+                self._free.append(p)
+        return n
+
     def free(self, pages: tp.Iterable[int]) -> None:
+        """Decref each page straight to the free list at zero (the
+        no-prefix-cache path)."""
         for p in pages:
-            if p not in self._held:
-                raise ValueError(f"freeing page {p} that is not held")
-            self._held.remove(p)
-            self._free.append(p)
+            self.decref(p, cache=False)
+
+    def reclaim(self, p: int) -> None:
+        """Cold cache -> free list (the prefix index evicted ``p``)."""
+        if p not in self._cached:
+            raise ValueError(f"reclaiming page {p} that is not cached")
+        self._cached.remove(p)
+        self._free.append(p)
 
     def check(self) -> None:
         """Assert the structural invariants (tests call this after every
         mutation sequence)."""
-        assert len(self._free) + len(self._held) == self.num_pages
+        assert (
+            len(self._free) + len(self._ref) + len(self._cached)
+            == self.num_pages
+        )
         assert len(set(self._free)) == len(self._free), "free-list dup"
-        assert not (set(self._free) & self._held), "page both free and held"
+        held = set(self._ref)
+        assert not (set(self._free) & held), "page both free and held"
+        assert not (set(self._free) & self._cached), "page both free/cached"
+        assert not (held & self._cached), "page both held and cached"
+        assert all(n >= 1 for n in self._ref.values()), "refcount < 1"
+
+
+class PrefixIndex:
+    """Host-side page-granular prefix index: content-addressed lookup of
+    resident KV pages by the token prefix they encode.
+
+    A page holding the KV of context positions ``[i*PS, (i+1)*PS)`` is
+    keyed by ``(parent_page, chunk)`` where ``chunk`` is that page's PS
+    tokens and ``parent_page`` is the indexed page of the preceding chunk
+    (-1 at the root) — the chain hash: KV at position j depends on the
+    whole prefix 0..j, so two pages are interchangeable iff their entire
+    token prefixes match, which the parent link encodes. Only FULL pages
+    are indexed (their contents are final: pages are append-only), so an
+    indexed page is immutable and safe to alias into any block table.
+
+    Refcounts live in :class:`PageAllocator`; the index only tracks the
+    content->page map, the parent/children tree, and an LRU order over
+    COLD pages (refcount 0, kept resident by the engine until page
+    pressure). Eviction is leaf-first: a page is reclaimable only when no
+    indexed child chains through it — ancestors of a held page are held
+    (matching shares whole chains from the root), so cold subtrees are
+    closed downward and a reclaimable leaf always exists while any cold
+    page does."""
+
+    _ROOT = -1
+
+    def __init__(self, page_size: int):
+        assert page_size >= 1, page_size
+        self.page_size = page_size
+        # (parent_page, chunk-tuple) -> page id
+        self._by_key: tp.Dict[tp.Tuple[int, tp.Tuple[int, ...]], int] = {}
+        # page id -> (parent_page, chunk-tuple)
+        self._meta: tp.Dict[int, tp.Tuple[int, tp.Tuple[int, ...]]] = {}
+        self._children: tp.Dict[int, tp.Set[int]] = {}
+        # cold (refcount-0) pages in LRU order; values unused
+        self._lru: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()
+        )
+
+    def __len__(self) -> int:
+        return len(self._meta)
+
+    def __contains__(self, page: int) -> bool:
+        return page in self._meta
+
+    @property
+    def cold_pages(self) -> int:
+        return len(self._lru)
+
+    def lookup(self, parent: int, chunk: tp.Sequence[int]) -> tp.Optional[int]:
+        """The indexed page for ``chunk`` under ``parent`` (-1 = root),
+        or None."""
+        return self._by_key.get((parent, tuple(int(t) for t in chunk)))
+
+    def match(
+        self, tokens: tp.Sequence[int]
+    ) -> tp.Tuple[tp.List[int], tp.Optional[int], int]:
+        """Longest cached prefix of ``tokens``: ``(full_pages, cow_src,
+        matched)`` — the chain of fully-matched page ids, an optional
+        page whose chunk *extends* the remaining partial tail (the
+        copy-on-write candidate), and the total matched token count.
+        ``tokens`` should already be capped below the full prompt (the
+        engine always recomputes at least the last prompt token, which
+        is how the first decode logits are produced)."""
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        full: tp.List[int] = []
+        parent = self._ROOT
+        i = 0
+        while i + ps <= len(toks):
+            page = self._by_key.get((parent, tuple(toks[i : i + ps])))
+            if page is None:
+                break
+            full.append(page)
+            parent = page
+            i += ps
+        rem = tuple(toks[i:])  # < ps after a full-match walk stops
+        cow = None
+        if rem:
+            for child in self._children.get(parent, ()):
+                _, chunk = self._meta[child]
+                if chunk[: len(rem)] == rem:
+                    cow = child
+                    break
+        matched = i + (len(rem) if cow is not None else 0)
+        return full, cow, matched
+
+    def register(
+        self, parent: int, chunk: tp.Sequence[int], page: int
+    ) -> int:
+        """Index ``page`` as holding ``chunk`` under ``parent``; returns
+        the CANONICAL page for that content — ``page`` itself normally,
+        or the already-indexed page when another request registered
+        identical content first (the duplicate stays private and
+        unindexed; callers chain future registrations through the
+        canonical id)."""
+        key = (parent, tuple(int(t) for t in chunk))
+        existing = self._by_key.get(key)
+        if existing is not None:
+            return existing
+        assert page not in self._meta, f"page {page} indexed twice"
+        self._by_key[key] = page
+        self._meta[page] = key
+        self._children.setdefault(parent, set()).add(page)
+        return page
+
+    def touch_cold(self, page: int) -> None:
+        """Mark an indexed page cold (refcount hit 0) or refresh its LRU
+        position."""
+        assert page in self._meta, page
+        self._lru[page] = None
+        self._lru.move_to_end(page)
+
+    def revive(self, page: int) -> None:
+        """A cold page got a hit (refcount 0 -> 1): leave the LRU."""
+        self._lru.pop(page, None)
+
+    def evict_cold_leaf(self) -> tp.Optional[int]:
+        """Drop the least-recently-used cold page that no indexed child
+        chains through; returns its id (caller reclaims it in the
+        allocator) or None when nothing is reclaimable."""
+        for page in self._lru:
+            if not self._children.get(page):
+                self._drop(page)
+                return page
+        return None
+
+    def _drop(self, page: int) -> None:
+        parent, chunk = self._meta.pop(page)
+        del self._by_key[(parent, chunk)]
+        self._children.get(parent, set()).discard(page)
+        self._children.pop(page, None)
+        self._lru.pop(page, None)
+
+    def check(self, alloc: tp.Optional[PageAllocator] = None) -> None:
+        """Structural invariants (property tests call this after every
+        scheduler step)."""
+        assert len(self._by_key) == len(self._meta)
+        for page, (parent, chunk) in self._meta.items():
+            assert self._by_key[(parent, chunk)] == page
+            assert parent == self._ROOT or parent in self._meta, (
+                f"page {page} chains through unindexed parent {parent}"
+            )
+            if parent != self._ROOT:
+                assert page in self._children[parent]
+        for page in self._lru:
+            assert page in self._meta
+        if alloc is not None:
+            for page in self._meta:
+                # indexed pages are resident: held or cold-cached
+                assert alloc.refcount(page) > 0 or page in alloc._cached
+            for page in self._lru:
+                assert alloc.refcount(page) == 0, (
+                    f"LRU page {page} still referenced"
+                )
 
 
 def pages_needed(tokens: int, page_size: int) -> int:
@@ -195,4 +409,56 @@ def write_prompt_pages(
             to_pages(vs).astype(pool.v.dtype), mode="drop"
         ),
         page_size=ps,
+    )
+
+
+def write_token_rows(
+    pool: PagedKVPool,
+    ks: Array,  # [L, Hkv, T, C] — chunk K from a suffix prefill (post-rope)
+    vs: Array,  # [L, Hkv, T, C]
+    bt_row: Array,  # [Pmax] int32 — the slot's block table (pad = sentinel)
+    start: Array,  # [] int32 — absolute position of chunk token 0
+    n_valid: Array,  # [] int32 — real tokens in the chunk (rest is pad)
+) -> PagedKVPool:
+    """Scatter a prefill chunk's K/V rows into the slot's pages at
+    positions ``start + j`` — token-granular (chunk boundaries need not
+    align to the page grid: a copy-on-write page hands the suffix an
+    mid-page start offset). Same non-adjacent-advanced-index layout as
+    :func:`flush_recent`; rows ``j >= n_valid`` route to the out-of-range
+    sentinel and drop."""
+    l, hkv, t, c = ks.shape
+    ps = pool.page_size
+    pmax = bt_row.shape[0]
+    pos = start + jnp.arange(t)  # [T]
+    valid = jnp.arange(t) < n_valid
+    page_idx = jnp.clip(pos // ps, 0, pmax - 1)
+    page = jnp.where(valid, bt_row[page_idx], pool.num_pages)
+    off = pos % ps
+    # advanced indices at axes 1 and 4 are non-adjacent: the broadcast
+    # [T] index dim moves to the FRONT — vals arrive [T, L, Hkv, C]
+    vals_k = jnp.transpose(ks, (2, 0, 1, 3))
+    vals_v = jnp.transpose(vs, (2, 0, 1, 3))
+    return PagedKVPool(
+        k=pool.k.at[:, page, :, :, off].set(
+            vals_k.astype(pool.k.dtype), mode="drop"
+        ),
+        v=pool.v.at[:, page, :, :, off].set(
+            vals_v.astype(pool.v.dtype), mode="drop"
+        ),
+        page_size=ps,
+    )
+
+
+def copy_page(pool: PagedKVPool, src: Array, dst: Array) -> PagedKVPool:
+    """Copy one page's K/V to another page — the copy-on-write primitive:
+    a request admitted onto a partially-shared cached page gets a private
+    copy it may append into, leaving the shared original untouched. One
+    dynamic slice + update per pool array; donate the pool when jitting
+    (the engine's compiled wrapper does)."""
+    k_row = jax.lax.dynamic_slice_in_dim(pool.k, src, 1, axis=1)
+    v_row = jax.lax.dynamic_slice_in_dim(pool.v, src, 1, axis=1)
+    return PagedKVPool(
+        k=jax.lax.dynamic_update_slice_in_dim(pool.k, k_row, dst, axis=1),
+        v=jax.lax.dynamic_update_slice_in_dim(pool.v, v_row, dst, axis=1),
+        page_size=pool.page_size,
     )

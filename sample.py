@@ -92,6 +92,15 @@ def main() -> None:
         help="KV page size (tokens) in --serve mode",
     )
     ap.add_argument(
+        "--serve_prefill_chunk", type=_positive_int, default=None,
+        help="chunked-prefill chunk size (tokens) in --serve mode; "
+        "default monolithic",
+    )
+    ap.add_argument(
+        "--no_prefix_cache", action="store_true",
+        help="disable prefix-cache page sharing in --serve mode",
+    )
+    ap.add_argument(
         "--eos_id", type=int, default=None,
         help="stop a request early at this token id (--serve mode only)",
     )
@@ -176,6 +185,8 @@ def main() -> None:
             top_k=args.top_k,
             window=args.serve_window,
             page_size=args.serve_page_size,
+            prefix_cache=not args.no_prefix_cache,
+            prefill_chunk=args.serve_prefill_chunk,
             seed=args.seed,
             mesh=mesh,
         )

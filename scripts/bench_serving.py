@@ -10,6 +10,16 @@ clock, and emits ONE JSON record:
   serve_slot_occupancy   mean fraction of decode slots busy per window
   serve_decode_dispatches / serve_prefill_dispatches
   serve_tokens_per_dispatch   steady-state K * slots when saturated
+  serve_prefix_hit_rate  prompt tokens served from the prefix cache
+  serve_prefill_tokens_saved / serve_prefill_tokens_computed
+  serve_cow_copies       copy-on-write page duplications
+
+A shared-system-prompt mix (--sys_prompt_len N) prepends one fixed
+N-token prefix to --sys_prompt_frac of all requests — the dominant
+shape of production traffic (system prompts / few-shot templates) and
+what the prefix cache exists for; run it with --prefix_cache on/off to
+ladder the win. --prefill_chunk C prefills Sarathi-style in C-token
+chunks interleaved with decode (bounds TTFT under long prompts).
 
 The decode-dispatch arithmetic is the point (PERF.md): the fixed-batch
 sampler launches one XLA dispatch per generated token; the engine fuses K
@@ -49,6 +59,15 @@ def main() -> None:
     ap.add_argument("--min_new", type=int, default=32)
     ap.add_argument("--max_new", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefix_cache", choices=("on", "off"), default="on")
+    ap.add_argument("--prefill_chunk", type=int, default=0,
+                    help="chunked-prefill chunk size in tokens "
+                    "(0 = monolithic prefill)")
+    ap.add_argument("--sys_prompt_len", type=int, default=0,
+                    help="length of a shared system prompt prepended to "
+                    "--sys_prompt_frac of requests (0 = independent "
+                    "prompts)")
+    ap.add_argument("--sys_prompt_frac", type=float, default=1.0)
     ap.add_argument("--out", default=None,
                     help="output JSON path (default "
                     "artifacts/bench_serving.json; the r6 queue's K-ladder "
@@ -92,10 +111,22 @@ def main() -> None:
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
     plens = rng.integers(args.min_prompt, args.max_prompt + 1, args.requests)
     nnews = rng.integers(args.min_new, args.max_new + 1, args.requests)
+    sys_prompt = rng.integers(
+        0, cfg.vocab_size, size=args.sys_prompt_len
+    ).astype(np.int32)
+    shared_mask = rng.random(args.requests) < args.sys_prompt_frac
     prompts = [
         rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
         for p in plens
     ]
+    if args.sys_prompt_len:
+        assert args.sys_prompt_len + args.max_prompt + args.max_new <= (
+            cfg.block_size
+        ), "system prompt + request mix must fit block_size"
+        prompts = [
+            np.concatenate([sys_prompt, p]) if shared_mask[i] else p
+            for i, p in enumerate(prompts)
+        ]
 
     eng = ServingEngine(
         model,
@@ -104,19 +135,26 @@ def main() -> None:
         window=args.window,
         temperature=0.0,
         seed=args.seed,
+        prefix_cache=args.prefix_cache == "on",
+        prefill_chunk=args.prefill_chunk or None,
     )
 
-    # warmup: compile the window + the prefill buckets the trace will hit
-    buckets = sorted({eng._prefill_bucket(int(p)) for p in plens})
+    # warmup: compile the decode window + EVERY prefill-chunk bucket the
+    # trace can dispatch. Full-prompt buckets are not enough: with the
+    # prefix cache on, admissions prefill arbitrary suffix lengths (and
+    # chunking caps them at prefill_chunk), so the cache-on/chunked
+    # ladder rungs would otherwise pay XLA compiles inside the timed
+    # region — corrupting exactly the comparison they exist for.
     eng.submit(prompts[0], int(nnews[0]))
     eng.run()
-    for b in buckets:
-        eng.submit(np.zeros((max(1, b - 1),), np.int32), 1)
-    eng.run()
+    eng.warm_prefill(max(p.size for p in prompts))
     eng.finished.clear()
+    eng.clear_prefix_cache()  # measured hit rates come from the trace alone
     for attr in ("decode_dispatches", "prefill_dispatches",
-                 "tokens_generated", "windows", "occupancy_sum",
-                 "evictions"):
+                 "copy_dispatches", "tokens_generated", "windows",
+                 "occupancy_sum", "evictions", "prompt_tokens_total",
+                 "prompt_tokens_cached", "prefill_tokens_computed",
+                 "cold_reclaims"):
         setattr(eng, attr, 0)
 
     t0 = time.monotonic()
@@ -146,7 +184,9 @@ def main() -> None:
         "device": jax.devices()[0].device_kind,
         "serve_shape": (
             f"{args.preset} S={args.slots} K={args.window} "
-            f"page={args.page_size}"
+            f"page={args.page_size} cache={args.prefix_cache} "
+            f"chunk={args.prefill_chunk or 'mono'} "
+            f"sys={args.sys_prompt_len}"
         ),
         "serve_requests": args.requests,
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
@@ -160,6 +200,11 @@ def main() -> None:
         "serve_tokens_generated": st["tokens_generated"],
         "serve_tokens_per_dispatch": st["tokens_per_dispatch"],
         "serve_evictions": st["evictions"],
+        "serve_prefix_hit_rate": st["prefix_hit_rate"],
+        "serve_prefill_tokens_saved": st["prefill_tokens_saved"],
+        "serve_prefill_tokens_computed": st["prefill_tokens_computed"],
+        "serve_cow_copies": st["copy_dispatches"],
+        "serve_cold_reclaims": st["cold_reclaims"],
     }
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")

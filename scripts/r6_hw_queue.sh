@@ -53,6 +53,21 @@ run serving_k1 python scripts/bench_serving.py --platform=tpu --window 1 \
   --out artifacts/bench_serving_k1.json
 run serving_k16 python scripts/bench_serving.py --platform=tpu --window 16 \
   --out artifacts/bench_serving_k16.json
+# Prefix-cache ladder on a shared-system-prompt mix (the traffic shape
+# the cache exists for): identical trace with the cache off vs on —
+# serve_prefix_hit_rate / serve_prefill_tokens_saved quantify the
+# prefill FLOPs skipped, tok_s and TTFT the end-to-end win. The third
+# rung adds Sarathi-style chunked prefill (128-token chunks) to bound
+# TTFT p99 under the long shared prompts.
+run serving_sys_nocache python scripts/bench_serving.py --platform=tpu \
+  --sys_prompt_len 256 --max_prompt 128 --prefix_cache off \
+  --out artifacts/bench_serving_sys_nocache.json
+run serving_sys_cache python scripts/bench_serving.py --platform=tpu \
+  --sys_prompt_len 256 --max_prompt 128 \
+  --out artifacts/bench_serving_sys_cache.json
+run serving_sys_chunked python scripts/bench_serving.py --platform=tpu \
+  --sys_prompt_len 256 --max_prompt 128 --prefill_chunk 128 \
+  --out artifacts/bench_serving_sys_chunked.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -147,7 +147,11 @@ def test_gpt_pp_train_step_matches_non_pp():
         MeshConfig(pipeline=1, replica=1, fsdp=2, sequence=1, tensor=1),
         2, x, y,
     )
-    np.testing.assert_allclose(loss_pp, loss_plain, rtol=2e-5)
+    # 1e-4, not 2e-5: on jax pins without partial-auto shard_map the PP
+    # region runs fully manual (compat.shard_map), which regathers the
+    # fsdp-sharded operands at region entry — same math, different f32
+    # reduction order across the 8 virtual devices (~5e-5 observed)
+    np.testing.assert_allclose(loss_pp, loss_plain, rtol=1e-4)
     # params after one update must match too (same grads through the bubble)
     for a, b in zip(
         jax.tree.leaves(state_pp.params), jax.tree.leaves(state_plain.params)

@@ -12,6 +12,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.models.gpt import GPT
 from midgpt_tpu.ops.attention import naive_attention
+from midgpt_tpu.compat import shard_map
 from midgpt_tpu.parallel.ring import ring_attention
 from midgpt_tpu.parallel.sharding import axis_rules
 
@@ -201,7 +202,7 @@ def test_zigzag_relayout_matches_index_oracle(mesh8):
     xs = jax.device_put(x, NamedSharding(mesh8, P(None, None, "sequence")))
 
     relayout_in = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a: _zigzag_relayout_in(a, "sequence", s),
             mesh=mesh8,
             in_specs=P(None, None, "sequence"),
@@ -210,7 +211,7 @@ def test_zigzag_relayout_matches_index_oracle(mesh8):
         )
     )
     roundtrip = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a: _zigzag_relayout_out(
                 _zigzag_relayout_in(a, "sequence", s), "sequence", s
             ),

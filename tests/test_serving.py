@@ -23,6 +23,7 @@ from midgpt_tpu.sampling import generate
 from midgpt_tpu.serving import (
     PageAllocator,
     PagedKVPool,
+    PrefixIndex,
     ServingEngine,
     flush_recent,
     generate_served,
@@ -427,6 +428,296 @@ def test_engine_rejects_oversized_requests():
     ref = _exact(model, long_prompt[-(CFG.block_size - 4):], 4)
     fin = eng.run()
     np.testing.assert_array_equal(np.asarray(fin[rid].tokens), ref)
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache (copy-on-write page sharing) + chunked prefill
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_and_chunking_token_identity():
+    """Acceptance: greedy output is token-identical per request with the
+    prefix cache on vs off and with chunked vs monolithic prefill —
+    shared-prefix traffic, mid-run admission (more requests than slots),
+    all against the exact fixed-batch sampler."""
+    model = _model()
+    sys_prompt = _prompts(1, base_len=18)[0]
+    tails = _prompts(4, base_len=3, stride=2)
+    prompts = [np.concatenate([sys_prompt, t]) for t in tails]
+    lens = [9, 12, 7, 10]
+    refs = [_exact(model, p, n) for p, n in zip(prompts, lens)]
+
+    def run(prefix_cache, prefill_chunk):
+        eng = ServingEngine(
+            model, slots=2, page_size=8, window=4, temperature=0.0,
+            cache_dtype=jnp.float32, prefix_cache=prefix_cache,
+            prefill_chunk=prefill_chunk,
+        )
+        rids = [eng.submit(p, n) for p, n in zip(prompts, lens)]
+        fin = eng.run()
+        eng.alloc.check()
+        if eng.index is not None:
+            eng.index.check(eng.alloc)
+        assert eng.alloc.held_pages == 0
+        return [fin[r].tokens for r in rids], eng
+
+    base, _ = run(False, None)
+    for variant in [(True, None), (False, 8), (True, 8), (True, 5)]:
+        toks, eng = run(*variant)
+        assert toks == base, f"variant {variant} diverged"
+    for i, r in enumerate(base):
+        np.testing.assert_array_equal(np.asarray(r), refs[i], err_msg=f"req {i}")
+
+
+def test_shared_prefix_skips_prefill_compute():
+    """Acceptance: a two-request shared-prefix scenario demonstrably
+    skips the shared pages' prefill — the second request computes only
+    the uncached suffix (token count asserted) and the hit rate is
+    positive."""
+    model = _model()
+    prompt = _prompts(1, base_len=24)[0]
+    eng = ServingEngine(
+        model, slots=1, page_size=8, window=4, temperature=0.0,
+        cache_dtype=jnp.float32, prefix_cache=True,
+    )
+    r1 = eng.submit(prompt, 6)
+    eng.run()
+    computed_first = eng.prefill_tokens_computed
+    assert computed_first == 24  # cold cache: the whole prompt
+    r2 = eng.submit(prompt, 6)
+    fin = eng.run()
+    # the second admission recomputes ONLY the last prompt token (the
+    # p-1 cap that produces the first decode logits); 16 tokens ride the
+    # two full shared pages, 7 the copy-on-write partial page
+    assert eng.prefill_tokens_computed - computed_first == 1
+    assert eng.prompt_tokens_cached == 23
+    assert eng.copy_dispatches == 1
+    st = eng.stats()
+    assert st["prefix_hit_rate"] > 0
+    assert st["prefill_tokens_saved"] == 23
+    np.testing.assert_array_equal(
+        np.asarray(fin[r1].tokens), np.asarray(fin[r2].tokens)
+    )
+    ref = _exact(model, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(fin[r2].tokens), ref)
+
+
+def test_multiturn_hits_decode_written_pages_with_parity():
+    """Multi-turn shape: turn 2's prompt extends turn 1's prompt AND its
+    GENERATED tokens, so the cache hit aliases pages whose K/V was
+    written by the decode flush, not by prefill — the one page-content
+    source the other exactness tests never exercise (decode and chunk
+    prefill use different einsum arithmetic; reuse must still be
+    token-identical to the cache-off recompute)."""
+    model = _model()
+    p0 = _prompts(1, base_len=12)[0]
+
+    def run(cache):
+        eng = ServingEngine(
+            model, slots=1, page_size=8, window=4, temperature=0.0,
+            cache_dtype=jnp.float32, prefix_cache=cache,
+        )
+        rA = eng.submit(p0, 10)
+        finA = eng.run()
+        turn2 = np.concatenate([
+            p0, np.asarray(finA[rA].tokens, np.int32),
+            np.asarray([7, 3], np.int32),  # the "user reply"
+        ])
+        rB = eng.submit(turn2, 10)
+        finB = eng.run()
+        return finA[rA].tokens, finB[rB].tokens, eng
+
+    toks_a_on, toks_b_on, eng_on = run(True)
+    toks_a_off, toks_b_off, _ = run(False)
+    assert toks_a_on == toks_a_off and toks_b_on == toks_b_off
+    # turn 2 really did alias decode-written pages: p0 is 12 tokens, so
+    # any hit past page 1 (16 tokens) covers generated positions
+    assert eng_on.prompt_tokens_cached > len(p0)
+    ref = _exact(model, np.concatenate([
+        p0, np.asarray(toks_a_on, np.int32), np.asarray([7, 3], np.int32)
+    ]), 10)
+    np.testing.assert_array_equal(np.asarray(toks_b_on), ref)
+
+
+def test_eviction_readmission_rehits_cache_with_parity():
+    """Under page pressure an evicted request's pages retire COLD; its
+    re-admission re-prefills via cache hits (tokens saved > 0) and the
+    output still matches the exact sampler bit-for-bit."""
+    model = _model()
+    prompts = _prompts(4, base_len=6, stride=0)
+    n_new = 24
+    refs = [_exact(model, p, n_new) for p in prompts]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=5, window=4,
+        temperature=0.0, cache_dtype=jnp.float32, prefix_cache=True,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    fin = eng.run()
+    assert eng.evictions > 0, "trace was sized to force eviction"
+    assert eng.prompt_tokens_cached > 0, (
+        "re-admissions should re-prefill via the cold prefix cache"
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    eng.alloc.check()
+    eng.index.check(eng.alloc)
+    assert eng.alloc.held_pages == 0
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """Sarathi property: with a per-window token budget, a long prompt's
+    prefill spreads over several windows while an already-running request
+    keeps decoding — the long prompt never monopolizes a window."""
+    model = _model()
+    short = _prompts(1, base_len=4)[0]
+    long = _prompts(1, base_len=48)[0]
+    refs = [_exact(model, short, 16), _exact(model, long, 8)]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, window=4, temperature=0.0,
+        cache_dtype=jnp.float32, prefill_chunk=8, prefill_budget=8,
+    )
+    r_short = eng.submit(short, 16)
+    eng.step()  # short is decoding
+    req_short = next(
+        r for r in eng.slot_req if r is not None and r.rid == r_short
+    )
+    tokens_before = len(req_short.tokens)
+    r_long = eng.submit(long, 8)
+    # the long prompt needs ceil(48/8)=6 chunks at 8 tokens/window: the
+    # short request must make decode progress during that prefill
+    eng.step()
+    eng.step()
+    assert any(
+        eng.prefilling[s] for s in range(eng.slots)
+    ), "long prompt should still be prefilling after 2 windows"
+    assert len(req_short.tokens) > tokens_before, (
+        "decode starved while the long prompt prefilled"
+    )
+    fin = eng.run()
+    np.testing.assert_array_equal(np.asarray(fin[r_short].tokens), refs[0])
+    np.testing.assert_array_equal(np.asarray(fin[r_long].tokens), refs[1])
+    assert eng.prefill_dispatches >= 6
+
+
+def test_sharing_invariants_property_loop():
+    """Property-style allocator/index invariants under a busy shared-
+    prefix trace with pressure: after EVERY scheduler step — refcounts
+    never negative (alloc.check), free+held+cached == num_pages, COW/tail
+    pages never aliased by two writers, shared pages only ever full
+    (indexed) ones, LRU only holds refcount-0 pages."""
+    model = _model()
+    sys_prompt = _prompts(1, base_len=16)[0]
+    tails = _prompts(6, base_len=2, stride=1)
+    prompts = [np.concatenate([sys_prompt, t]) for t in tails]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=10, window=4,
+        temperature=0.0, cache_dtype=jnp.float32, prefix_cache=True,
+        prefill_chunk=8,
+    )
+    rids = [eng.submit(p, 10, seed=i) for i, p in enumerate(prompts)]
+    steps = 0
+    while (eng.queue or eng._active_slots()) and steps < 500:
+        eng.step()
+        steps += 1
+        eng.alloc.check()
+        eng.index.check(eng.alloc)
+        ps = eng.page_size
+        for s in eng._active_slots():
+            n_pages = len(eng.slot_pages[s])
+            pl = int(eng.pooled_len[s])
+            for i, pg in enumerate(eng.slot_pages[s]):
+                if pg in eng.index:
+                    continue  # full + indexed: immutable, safely shared
+                # private (writable) pages must have exactly one owner
+                # and appear in exactly one block table
+                assert eng.alloc.refcount(pg) == 1, (
+                    f"writer page {pg} shared (ref "
+                    f"{eng.alloc.refcount(pg)})"
+                )
+                owners = [
+                    v for v in eng._active_slots()
+                    if pg in eng.slot_pages[v]
+                ]
+                assert owners == [s], (
+                    f"page {pg} aliased by slots {owners}"
+                )
+    assert steps < 500, "engine did not drain"
+    assert eng.alloc.held_pages == 0
+    # freeing a request decrefs exactly its pages: everything is now
+    # free or cold-cached
+    assert (
+        eng.alloc.free_pages + eng.alloc.cached_pages
+        == eng.alloc.num_pages
+    )
+    # all requests completed with the right token counts
+    for r in rids:
+        assert len(eng.finished[r].tokens) == 10
+
+
+def test_cold_lru_eviction_only_reclaims_refcount_zero_leaves():
+    """Unit-level: evict_cold_leaf never returns a page that is still
+    referenced or that an indexed child chains through."""
+    alloc = PageAllocator(8)
+    index = PrefixIndex(4)
+    # two chains: [a, b] and [c]; a/b retire cold, c stays held
+    a, b, c = alloc.alloc(3)
+    a = index.register(-1, [1, 2, 3, 4], a)
+    b = index.register(a, [5, 6, 7, 8], b)
+    c = index.register(-1, [9, 9, 9, 9], c)
+    alloc.decref(a, cache=True)
+    index.touch_cold(a)
+    alloc.decref(b, cache=True)
+    index.touch_cold(b)
+    # a was touched first (LRU) but has child b -> b must evict first
+    v1 = index.evict_cold_leaf()
+    assert v1 == b
+    alloc.reclaim(v1)
+    v2 = index.evict_cold_leaf()
+    assert v2 == a
+    alloc.reclaim(v2)
+    # c is held (refcount 1): never reclaimable
+    assert index.evict_cold_leaf() is None
+    assert alloc.refcount(c) == 1 and c in index
+    alloc.check()
+    index.check(alloc)
+
+
+def test_allocator_refcount_never_negative():
+    a = PageAllocator(4)
+    (p,) = a.alloc(1)
+    a.incref(p)
+    assert a.refcount(p) == 2
+    assert a.decref(p) == 1
+    assert a.decref(p) == 0
+    with pytest.raises(ValueError):
+        a.decref(p)  # already free: refcount can never go negative
+    with pytest.raises(ValueError):
+        a.incref(p)  # free pages cannot be shared
+    a.check()
+    # cached pages revive through incref
+    (q,) = a.alloc(1)
+    a.decref(q, cache=True)
+    assert a.cached_pages == 1
+    a.incref(q)
+    assert a.refcount(q) == 1 and a.cached_pages == 0
+    a.check()
+
+
+@pytest.mark.slow
+def test_prefill_chunk_audit_donation_and_host_sync():
+    """The compiled suffix-prefill chunk program passes the serving
+    invariants (donation intact, no host sync) — the program chunked
+    prefill dispatches between every pair of decode windows."""
+    from midgpt_tpu.analysis.harness import audit_prefill_chunk
+    from midgpt_tpu.config import get_config
+
+    analysis, report = audit_prefill_chunk(
+        get_config("shakespeare_char"), chunk_len=32, page_size=8
+    )
+    assert report.ok, report.violations
+    assert analysis.donated_leaves == 3  # pool.k, pool.v, logits
 
 
 @pytest.mark.slow
