@@ -90,11 +90,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--serving", action="store_true",
-        help="audit the serving engine's fused K-step DECODE window "
-        "(midgpt_tpu.serving) instead of the train step: donation must "
-        "stay intact across the window (KV pool + logits alias "
-        "input->output) and no host sync may hide inside it; "
-        "--steps-per-dispatch sets K (default 4)",
+        help="audit the serving engine's three hot-path programs "
+        "(midgpt_tpu.serving) instead of the train step: the fused "
+        "K-step DECODE window, the suffix-prefill CHUNK, and the "
+        "speculative VERIFY program — donation must stay intact (KV "
+        "pool + logits alias input->output) and no host sync may hide "
+        "inside any of them; --steps-per-dispatch sets K (default 4)",
     )
     p.add_argument(
         "--serving-slots", type=int, default=4, metavar="S",
@@ -103,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--serving-page-size", type=int, default=16, metavar="P",
         help="KV page size for the serving audit (default 16)",
+    )
+    p.add_argument(
+        "--serving-spec-len", type=int, default=4, metavar="N",
+        help="draft length for the speculative verify-program audit "
+        "(default 4)",
     )
     return p
 
@@ -190,6 +196,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         from midgpt_tpu.analysis.harness import (
             audit_decode_window,
             audit_prefill_chunk,
+            audit_verify_program,
         )
 
         k = args.steps_per_dispatch or 4
@@ -208,15 +215,26 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
         )
-        ok = report.ok and chunk_report.ok
+        # with speculation on every decode dispatch IS a verify dispatch:
+        # audit the verify program on the same geometry as the other two
+        # (_serving_audit_setup is shared by all three compiles)
+        spec_analysis, spec_report = audit_verify_program(
+            cfg,
+            slots=args.serving_slots,
+            spec_len=args.serving_spec_len,
+            page_size=args.serving_page_size,
+            shrink=not args.no_shrink,
+        )
+        ok = report.ok and chunk_report.ok and spec_report.ok
         out = {
             "config": args.config,
-            "mode": "serving-decode-window+prefill-chunk",
+            "mode": "serving-decode-window+prefill-chunk+verify-program",
             "ok": ok,
             "geometry": {
                 "slots": args.serving_slots,
                 "steps_per_dispatch": k,
                 "page_size": args.serving_page_size,
+                "spec_len": args.serving_spec_len,
                 "donated_leaves": analysis.donated_leaves,
                 "aliased_buffers": len(
                     {e.param_number for e in analysis.aliases}
@@ -230,6 +248,13 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                 ),
                 "rules": chunk_report.to_dict()["rules"],
             },
+            "verify_program": {
+                "donated_leaves": spec_analysis.donated_leaves,
+                "aliased_buffers": len(
+                    {e.param_number for e in spec_analysis.aliases}
+                ),
+                "rules": spec_report.to_dict()["rules"],
+            },
         }
         text = json.dumps(out, indent=2)
         print(text)
@@ -237,7 +262,11 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             with open(args.json, "w") as f:
                 f.write(text + "\n")
         if not ok:
-            for v in report.violations + chunk_report.violations:
+            for v in (
+                report.violations
+                + chunk_report.violations
+                + spec_report.violations
+            ):
                 print(f"VIOLATION {v}", file=sys.stderr)
             return 1
         return 0

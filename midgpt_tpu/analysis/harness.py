@@ -195,10 +195,11 @@ def audit_config(
 
 def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
                          page_size: int, shrink: bool):
-    """Shared geometry for the two serving audits (decode window +
-    prefill chunk): audit-shrunk model config, 1-device mesh, bf16-cast
-    model, page pool and slot logits. ONE definition so the two compiled
-    programs can never silently audit different geometries."""
+    """Shared geometry for the three serving audits (decode window +
+    prefill chunk + speculative verify): audit-shrunk model config,
+    1-device mesh, bf16-cast model, page pool and slot logits. ONE
+    definition so the compiled programs can never silently audit
+    different geometries."""
     import dataclasses as _dc
 
     import jax
@@ -382,6 +383,92 @@ def audit_prefill_chunk(
         hlo,
         hlo_mod.MeshInfo.from_mesh(mesh, num_slices=1),
         global_batch=1,
+        block=block,
+        donated_leaves=donated,
+    )
+    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
+        analysis
+    )
+    return analysis, report
+
+
+def compile_verify_program(
+    cfg: ExperimentConfig,
+    *,
+    slots: int = 4,
+    spec_len: int = 4,
+    page_size: int = 16,
+    shrink: bool = True,
+):
+    """Compile the serving engine's speculative VERIFY program
+    (``midgpt_tpu.serving.make_verify_program``) — the single dispatch
+    that scores all slots' ``spec_len + 1`` candidate rows against the
+    resident pages, decides greedy acceptance, and folds only accepted
+    rows' K/V into the pool. Returns ``(hlo_text, mesh, donated_leaves,
+    audited_block_size)``.
+
+    Audited for the same serving invariants as the decode window and the
+    prefill chunk: pool + logits donation intact (with speculation on,
+    EVERY decode dispatch is a verify dispatch — an un-aliased pool
+    would double KV HBM on the hottest path in the engine) and no host
+    sync inside the compiled program (drafting is host-side but arrives
+    as ordinary inputs; acceptance, watermark, rollback and the page
+    write are all in-program — one stray callback would stall every
+    speculated token)."""
+    import jax
+    import numpy as np_
+
+    from midgpt_tpu.serving.engine import make_verify_program
+
+    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
+        cfg, slots=slots, page_size=page_size, shrink=shrink
+    )
+    verify_fn = make_verify_program(
+        model, slots=slots, spec_len=spec_len, pmax=pmax,
+        rope_len=model_cfg.block_size,
+    )
+    i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
+    hlo = verify_fn.lower(
+        pool, logits, i32(slots, pmax), i32(slots),
+        np_.zeros((slots,), bool), i32(slots), i32(slots), i32(slots),
+        i32(slots, spec_len), i32(slots),
+    ).compile().as_text()
+    donated_leaves = len(jax.tree.leaves((pool, logits)))
+    return hlo, mesh, donated_leaves, model_cfg.block_size
+
+
+def audit_verify_program(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    slots: int = 4,
+    spec_len: int = 4,
+    page_size: int = 16,
+    shrink: bool = True,
+) -> tp.Tuple[StepAnalysis, Report]:
+    """One-call audit of the speculative verify program: donation-intact,
+    no-host-sync, no-f64 — the CI serving-audit job runs this next to
+    :func:`audit_decode_window` and :func:`audit_prefill_chunk` so all
+    three serving hot-path programs are gated on one geometry."""
+    from midgpt_tpu.analysis.rules import (
+        DonationIntact,
+        NoF64,
+        NoHostSync,
+        RuleSet,
+    )
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    hlo, mesh, donated, block = compile_verify_program(
+        cfg, slots=slots, spec_len=spec_len, page_size=page_size,
+        shrink=shrink,
+    )
+    analysis = StepAnalysis.from_text(
+        hlo,
+        hlo_mod.MeshInfo.from_mesh(mesh, num_slices=1),
+        global_batch=slots,
         block=block,
         donated_leaves=donated,
     )

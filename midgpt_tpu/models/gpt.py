@@ -563,6 +563,99 @@ class Attention:
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
         return self.wo(out.astype(x.dtype)), k, v
 
+    def verify_paged_at(
+        self,
+        x: Array,  # [S, T, D] — the verify dispatch's candidate rows
+        pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+        pool_v: Array,  # [L, NP, Hkv, C, PS]
+        bt: Array,  # [S, Pmax] int32 per-slot block tables
+        layer: int,  # STATIC layer index
+        mask_pool: Array,  # [S, 1, 1, 1, W] additive f32 (0 where resident)
+        mask_self: Array,  # [T, T] additive causal f32 within the rows
+        sin_rows: Array,  # [S, 1, T, C//2] per-slot rope rows
+        cos_rows: Array,
+    ) -> tp.Tuple[Array, Array, Array]:
+        """Multi-query attention for SPECULATIVE VERIFICATION: all T
+        candidate rows of every slot attend jointly to the slot's
+        resident pages plus themselves (causal), one joint softmax.
+
+        The dtype choreography deliberately MIRRORS
+        :meth:`decode_paged_at` op for op — f32 upcast BEFORE the
+        score multiply-sums, f32 probs through the PV contraction,
+        mask added before the in-softmax ``/ sqrt(c)`` — NOT the
+        prefill chunk's naive_attention choreography. Acceptance
+        compares the verify logits' argmax against what the decode
+        window would have sampled; on a real bf16 checkpoint the two
+        choreographies disagree by ~2 bf16 ulps, enough to flip
+        near-tied greedy argmaxes (caught by the sample.py --serve
+        --serve_spec verify drive on a trained checkpoint — the same
+        class of flip PR 4 hit with a cast-early prefill variant).
+        Mirroring the decode arithmetic pins spec-on to the decode
+        path at f32-reduction granularity, the same equivalence class
+        as the tested K=4 vs K=1 window invariance."""
+        b, t, d = x.shape
+        h, hkv = self.n_head, self.n_kv_head
+        c = d // h
+        qkv = self.wqkv(x)  # [S, T, (H+2Hkv)C]
+        q = qkv[..., : h * c].reshape(b, t, h, c)
+        k = qkv[..., h * c : (h + hkv) * c].reshape(b, t, hkv, c)
+        v = qkv[..., (h + hkv) * c :].reshape(b, t, hkv, c)
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+            k = self.k_norm(k)
+        q = jnp.transpose(q, (0, 2, 1, 3))  # [S, H, T, C]
+        k = jnp.transpose(k, (0, 2, 1, 3))  # [S, Hkv, T, C]
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        q = apply_rotary(q, sin_rows, cos_rows)
+        k = apply_rotary(k, sin_rows, cos_rows)
+        # gather the slots' pages (clip-mode for the same NaN reason as
+        # decode_paged_at) -> logical KV [S, Hkv, C, W] in page order
+        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+        _, pmax, _, _, ps = pk_l.shape
+        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        qg = q.reshape(b, hkv, h // hkv, t, c)  # [S, Hkv, G, T, C]
+        # the decode window stores each step's K/V into the CACHE-dtype
+        # recent buffer and reads it back for the in-window scores — so
+        # rows see cache-rounded self keys/values. Mirror that rounding
+        # (an identity when cache dtype == compute dtype, but an f32
+        # model over a bf16 pool would otherwise score un-rounded self
+        # keys and flip near-tied acceptance argmaxes)
+        kc = k.astype(pool_k.dtype)
+        vc = v.astype(pool_v.dtype)
+        # scores as f32 broadcast-multiply + reduce, exactly the decode
+        # VPU form — q upcast first, cache upcast first, sum over C
+        s_pool = jnp.sum(
+            qg[..., :, None].astype(jnp.float32)
+            * ck[:, :, None, None].astype(jnp.float32),
+            axis=-2,
+        )  # [S, Hkv, G, T, W]
+        s_self = jnp.sum(
+            qg[:, :, :, :, None, :].astype(jnp.float32)
+            * kc[:, :, None, None].astype(jnp.float32),
+            axis=-1,
+        )  # [S, Hkv, G, T, T]
+        s_all = jnp.concatenate(
+            [s_pool + mask_pool, s_self + mask_self], axis=-1
+        )
+        probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
+        p_pool = probs[..., : s_pool.shape[-1]]
+        p_self = probs[..., s_pool.shape[-1]:]
+        o_pool = jnp.sum(
+            p_pool[:, :, :, :, None, :]
+            * cv[:, :, None, None].astype(jnp.float32),
+            axis=-1,
+        )  # [S, Hkv, G, T, C]
+        o_self = jnp.sum(
+            p_self[..., None] * vc[:, :, None, None].astype(jnp.float32),
+            axis=-2,
+        )  # [S, Hkv, G, T, C]
+        out = (o_pool + o_self).astype(x.dtype)
+        out = out.reshape(b, h, t, c)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+        return self.wo(out), k, v
+
     def decode_recent_at(
         self,
         x: Array,  # [B, 1, D]
@@ -1012,6 +1105,18 @@ class Block:
         sin_rows, cos_rows,
     ):
         attn_out, k, v = self.attn.prefill_paged_at(
+            self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
+            sin_rows, cos_rows,
+        )
+        x = x + attn_out
+        x = x + mlp_call(self.mlp, self.ln2(x))[0]
+        return x, k, v
+
+    def verify_paged_at(
+        self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
+        sin_rows, cos_rows,
+    ):
+        attn_out, k, v = self.attn.verify_paged_at(
             self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
             sin_rows, cos_rows,
         )
@@ -1472,6 +1577,78 @@ def prefill_chunk_paged(
         vs.append(v)
     h = model.ln_f(h)
     return h, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, 1, Hkv, T, C]
+
+
+def verify_tokens_paged(
+    model: GPT,
+    tokens: Array,  # [S, T] int32 — candidate rows per decode slot
+    start: Array,  # [S] int32 — per-slot absolute position of row 0 (the
+    # slot's write watermark: tokens already resident in the pool)
+    pool_k: Array,  # [L, NP, Hkv, C, PS] page pool, READ-ONLY here
+    pool_v: Array,
+    bt: Array,  # [S, Pmax] int32 per-slot block tables
+    rope_len: int,
+) -> tp.Tuple[Array, Array, Array]:
+    """Speculative-decoding VERIFICATION forward: score every slot's
+    ``[T = spec_len + 1]`` candidate rows (the true next token + the
+    drafted continuation) in one batched multi-query pass over the
+    resident paged KV — all slots, all rows, ONE dispatch.
+
+    This is :func:`prefill_chunk_paged` generalized from one slot to the
+    whole decode batch: each slot's rows attend to its OWN block-table
+    pages (positions ``< start[s]`` — per-slot masks, continuous batching
+    mixes depths) plus themselves causally, one joint softmax
+    (``Attention.verify_paged_at``). The attention DTYPE CHOREOGRAPHY
+    mirrors the decode window's (``decode_paged_at``), not the prefill
+    chunk's: acceptance compares these logits' argmax against what the
+    decode path would have sampled at the same positions, and on a real
+    bf16 checkpoint the prefill choreography differs by enough bf16 ulps
+    to flip near-tied greedy argmaxes (caught by the sample.py --serve
+    --serve_spec drive). Mirrored, greedy acceptance decisions are the
+    decisions the non-speculative engine would have made one token at a
+    time.
+
+    Returns ``(logits, ks, vs)``: per-row next-token logits [S, T, V]
+    (row j scores position ``start + j + 1`` — exact whenever rows
+    ``0..j`` are the true context, which is precisely what acceptance
+    checks) and the rows' post-rope K / raw V [L, S, Hkv, T, C] for the
+    watermark-masked page write (only accepted rows' K/V ever lands;
+    rejected rows are dropped by the scatter mask — the rollback)."""
+    cfg = model.config
+    s, t = tokens.shape
+    pmax = bt.shape[1]
+    ps = pool_k.shape[-1]
+    sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
+    sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
+
+    # paged slot w of the gathered [W = Pmax*PS] view holds logical
+    # position w; resident iff w < start[s] — per-slot, broadcast over
+    # (Hkv, G, T) in the [S, Hkv, G, T, W] score tensor
+    idx = jnp.arange(pmax * ps)
+    mask_pool = jnp.where(
+        idx[None, :] < start[:, None], 0.0, -jnp.inf
+    ).astype(jnp.float32)[:, None, None, None, :]  # [S, 1, 1, 1, W]
+    ii = jnp.arange(t)
+    mask_self = jnp.where(
+        ii[None, :] <= ii[:, None], 0.0, -jnp.inf
+    ).astype(jnp.float32)  # [T, T]
+    pos = jnp.clip(start[:, None] + ii[None, :], 0, rope_len - 1)  # [S, T]
+    sin_rows = jnp.take(sin_t, pos, axis=0)[:, None]  # [S, 1, T, C//2]
+    cos_rows = jnp.take(cos_t, pos, axis=0)[:, None]
+
+    h = embed_tokens(model.wte, tokens)  # [S, T, D]
+    sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
+    ks, vs = [], []
+    for i in range(cfg.n_layer):
+        block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
+        h, k, v = block.verify_paged_at(
+            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h
+        )
+        ks.append(k)
+        vs.append(v)
+    h = model.ln_f(h)
+    logits = h @ model.head_weight(h.dtype)  # [S, T, V]
+    return logits, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, S, Hkv, T, C]
 
 
 def merge_recent(

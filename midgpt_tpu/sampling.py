@@ -55,7 +55,15 @@ def _pin_cache_layout(cache: KVCache) -> KVCache:
     )
 
 
-def _sample_token(logits: Array, key: Array, temperature: float, top_k: tp.Optional[int]) -> Array:
+def sample_token(
+    logits: Array, key: Array, temperature: float, top_k: tp.Optional[int]
+) -> Array:
+    """One sampling decision: greedy argmax at ``temperature == 0``,
+    temperature-scaled (optionally top-k-filtered) categorical otherwise.
+    Shared by the fixed-batch sampler below and the serving engine's
+    decode window; the serving VERIFY program's acceptance check is the
+    ``temperature == 0`` branch of this function applied per candidate
+    row — which is why speculation is exactly greedy-equivalent."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -65,6 +73,9 @@ def _sample_token(logits: Array, key: Array, temperature: float, top_k: tp.Optio
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+_sample_token = sample_token  # back-compat alias (pre-PR 5 private name)
 
 
 def generate(
@@ -132,7 +143,7 @@ def generate(
         def body(carry, _):
             logits, r, rk, rv, k = carry
             k, sub = jax.random.split(k)
-            tok = _sample_token(logits, sub, temperature, top_k)
+            tok = sample_token(logits, sub, temperature, top_k)
             new_logits, rk, rv = decode_step_recent(
                 model, tok, base + r, cache, rk, rv, r, base, w, total
             )
@@ -212,7 +223,7 @@ def generate(
     def body2(carry, _):
         logits, window, k = carry
         k, sub = jax.random.split(k)
-        tok = _sample_token(logits, sub, temperature, top_k)
+        tok = sample_token(logits, sub, temperature, top_k)
         window = jnp.concatenate([window[:, 1:], tok[:, None]], axis=1)
         new_logits = model(window, attn_impl=impl)[:, -1, :]
         return (new_logits, window, k), tok

@@ -18,6 +18,11 @@ Public surface:
   fused K-step decode program and the suffix-prefill chunk program
   (both audited for donation and host-sync regressions:
   ``python -m midgpt_tpu.analysis --serving``).
+- :func:`~midgpt_tpu.serving.engine.make_verify_program`,
+  :class:`~midgpt_tpu.serving.speculate.NgramProposer` — self-speculative
+  decoding: draft-model-free n-gram drafting plus the single-dispatch
+  paged verification program (``ServingEngine(speculate=N)``; audited
+  next to the other two serving programs).
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -34,7 +39,9 @@ from midgpt_tpu.serving.engine import (
     make_copy_page_program,
     make_decode_window,
     make_prefill_chunk_program,
+    make_verify_program,
 )
+from midgpt_tpu.serving.speculate import NgramProposer, Proposer
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
@@ -47,9 +54,11 @@ from midgpt_tpu.serving.paged import (
 )
 
 __all__ = [
+    "NgramProposer",
     "PageAllocator",
     "PagedKVPool",
     "PrefixIndex",
+    "Proposer",
     "Request",
     "ServingEngine",
     "copy_page",
@@ -58,6 +67,7 @@ __all__ = [
     "make_copy_page_program",
     "make_decode_window",
     "make_prefill_chunk_program",
+    "make_verify_program",
     "pages_needed",
     "write_prompt_pages",
     "write_token_rows",
@@ -80,13 +90,16 @@ def generate_served(
     prefix_cache: bool = True,
     prefill_chunk: tp.Optional[int] = None,
     prefill_budget: tp.Optional[int] = None,
+    speculate: int = 0,
     mesh=None,
 ) -> tp.List[np.ndarray]:
     """One-shot batch generation routed through the serving engine: submit
     every prompt, drain, return the generated token arrays in submission
     order. The engine path to the fixed-batch ``sampling.generate`` —
     same greedy tokens, 1/K the decode dispatches, and per-request early
-    exit at ``eos_id``."""
+    exit at ``eos_id``. ``speculate=N`` (greedy only) turns decode
+    dispatches into n-gram-drafted verify dispatches emitting
+    ``1 + accepted`` tokens each — same tokens, fewer launches."""
     import jax.numpy as jnp
 
     eng = ServingEngine(
@@ -101,6 +114,7 @@ def generate_served(
         prefix_cache=prefix_cache,
         prefill_chunk=prefill_chunk,
         prefill_budget=prefill_budget,
+        speculate=speculate,
         mesh=mesh,
     )
     rids = [

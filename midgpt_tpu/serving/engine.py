@@ -25,6 +25,21 @@ PERF.md r5) once per generated token. This engine replaces both:
   into free decode slots at every window boundary, interleaves their
   prefills with decode, and evicts (re-queues with progress kept) under
   page pressure — slots stay full under mixed traffic.
+- **Self-speculative decoding** (serving.speculate + the verify program
+  below): a host-side n-gram proposer drafts up to ``speculate`` tokens
+  per request from the request's OWN prompt+generated history (prompt-
+  lookup style — no draft model, composes with every config), and one
+  jitted pool/logits-donating dispatch scores all slots' ``spec_len+1``
+  candidate rows in one joint-softmax multi-query pass whose arithmetic
+  mirrors the decode window's op for op (gpt.verify_paged_at — bf16
+  near-ties flip under any other dtype choreography).
+  Greedy acceptance is longest-prefix argmax agreement; each dispatch
+  emits 1 + accepted tokens (the "+1" is the previous dispatch's bonus
+  token, materialized from the carried logits). Rejected rows roll back
+  via a per-slot write watermark: their K/V never lands in the pages,
+  so the single-writer / refcount / prefix-index invariants are
+  untouched. Greedy outputs are token-identical to the non-speculative
+  engine — speculation changes the dispatch count, not the stream.
 - **Fused multi-token dispatch** (the PR 2 design, ported to decode): one
   jitted, state-donating ``lax.scan`` runs K whole-model decode steps —
   all layers, sampling, and the bulk page flush — per XLA launch.
@@ -55,7 +70,9 @@ from midgpt_tpu.models.gpt import (
     GPT,
     decode_step_paged,
     prefill_chunk_paged,
+    verify_tokens_paged,
 )
+from midgpt_tpu.serving.speculate import NgramProposer, Proposer
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
@@ -104,7 +121,7 @@ def make_decode_window(
     prefill and decode interleave without a second program shape.
     """
     from midgpt_tpu.parallel.sharding import axis_rules
-    from midgpt_tpu.sampling import _sample_token
+    from midgpt_tpu.sampling import sample_token
 
     cfg = model.config
     rshape = (cfg.n_layer, slots, cfg.kv_heads, window, cfg.head_dim)
@@ -139,7 +156,7 @@ def make_decode_window(
                     )
                 )(seeds, em)
                 return jax.vmap(
-                    lambda l1, k1: _sample_token(
+                    lambda l1, k1: sample_token(
                         l1[None], k1, temperature, top_k
                     )[0]
                 )(lg, ks)
@@ -235,6 +252,124 @@ def make_prefill_chunk_program(
     return jax.jit(chunk_fn, donate_argnums=(0, 1))
 
 
+def make_verify_program(
+    model: GPT,
+    *,
+    slots: int,
+    spec_len: int,
+    pmax: int,
+    rope_len: int,
+    pad_id: int = 0,
+    mesh=None,
+):
+    """The speculative-decoding verification program: ONE jitted,
+    pool/logits-donating dispatch that scores every slot's
+    ``[T = spec_len + 1]`` candidate rows (the true next token, argmaxed
+    in-program from the carried logits, followed by the host's drafts)
+    against the resident paged KV via ``models.gpt.verify_tokens_paged``,
+    computes greedy longest-prefix acceptance, EOS/budget truncation, and
+    the per-slot WRITE WATERMARK, and folds only the accepted rows' K/V
+    into the pages (one bulk scatter — rejected rows route to the drop
+    sentinel, which IS the rollback: stale speculation never becomes
+    visible to the pool, the prefix index, or another block table).
+
+    Per dispatch each live slot emits ``1 + accepted`` tokens: row 0 is
+    exact by construction (it is what the non-speculative window's first
+    step would have sampled from the same carried logits), and draft row
+    j is accepted iff it equals the argmax after row j-1 — which, chained
+    from row 0, is exactly the token the plain engine would have produced
+    there. The carried logits row advances to the last EMITTED row's
+    logits, so the next dispatch's row 0 is this dispatch's bonus token
+    (the model's own continuation at the first mismatch). Greedy only —
+    the engine asserts ``temperature == 0`` when speculation is on.
+
+    Slot semantics mirror :func:`make_decode_window` exactly: done/empty
+    slots ride along masked (pad candidates, no emissions, no writes),
+    budget counts emitted tokens, an emitted EOS is kept and everything
+    after it dropped, and a terminal token's K/V row is not written (no
+    real token can follow it)."""
+    from midgpt_tpu.parallel.sharding import axis_rules
+
+    assert spec_len >= 1, spec_len
+    t = spec_len + 1
+
+    def verify_fn(
+        pool: PagedKVPool,  # DONATED
+        logits: Array,  # [S, V] f32 — per-slot next-token logits; DONATED
+        bt: Array,  # [S, Pmax] int32 block tables
+        pooled_len: Array,  # [S] int32 — write watermark (tokens resident)
+        done: Array,  # [S] bool — finished or empty slot
+        emitted: Array,  # [S] int32 — tokens emitted so far per request
+        budget: Array,  # [S] int32 — max_new_tokens per request
+        eos: Array,  # [S] int32 — per-request EOS id (-1 = none)
+        drafts: Array,  # [S, spec_len] int32 — host n-gram drafts
+        n_draft: Array,  # [S] int32 in [0, spec_len] — per-slot draft len
+    ):
+        assert bt.shape == (slots, pmax), (
+            f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
+        )
+        with axis_rules(mesh):
+            # row 0: the true next token, materialized from the carried
+            # logits (greedy — the same argmax the window's step 0 takes)
+            t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t0 = jnp.where(done, jnp.int32(pad_id), t0)
+            cand = jnp.concatenate([t0[:, None], drafts], axis=1)  # [S, T]
+            all_logits, ks, vs = verify_tokens_paged(
+                model, cand, pooled_len, pool.k, pool.v, bt, rope_len
+            )  # all_logits: [S, T, V]; ks/vs: [L, S, Hkv, T, C]
+            preds = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+            # draft row j (cand[:, j], j >= 1) matches iff it equals the
+            # model's argmax after row j-1 and sits within the slot's
+            # draft length; acceptance is the longest matching PREFIX
+            match = (cand[:, 1:] == preds[:, :-1]) & (
+                jnp.arange(spec_len)[None, :] < n_draft[:, None]
+            )
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1) > 0
+            ok = jnp.concatenate(
+                [jnp.ones((slots, 1), bool), acc], axis=1
+            )  # [S, T] — row 0 always a real emission for a live slot
+            allowed = budget - emitted  # >= 1 for any live slot
+            ok = ok & (jnp.arange(t)[None, :] < allowed[:, None])
+            ok = ok & ~done[:, None]
+            # an emitted EOS is kept; every row after it is dropped
+            is_eos = ok & (cand == eos[:, None])
+            eos_before = (
+                jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                - is_eos.astype(jnp.int32)
+            ) > 0
+            emit = ok & ~eos_before  # [S, T] — always a contiguous prefix
+            n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)  # [S]
+            new_emitted = emitted + n_emit
+            hit_eos = jnp.any(emit & (cand == eos[:, None]), axis=1)
+            new_done = done | hit_eos | (new_emitted >= budget)
+            # write watermark: every emitted row's K/V is true context —
+            # except a terminal row (EOS/budget), which no token follows
+            # (same write_valid discipline as the decode window)
+            n_write = n_emit - (new_done & ~done).astype(jnp.int32)
+            n_write = jnp.maximum(n_write, 0)
+            wvalid = jnp.arange(t)[None, :] < n_write[:, None]  # [S, T]
+            pool = flush_recent(pool, ks, vs, bt, pooled_len, wvalid)
+            new_len = pooled_len + n_write
+            # carried logits: after the last emitted row (exact — its
+            # whole prefix was accepted); done slots take row 0, which is
+            # scratch until an admission overwrites the row. f32 widening
+            # is exact, same as the decode window's carry.
+            last = jnp.clip(n_emit - 1, 0, t - 1)
+            new_logits = jnp.take_along_axis(
+                all_logits, last[:, None, None], axis=1
+            )[:, 0].astype(logits.dtype)
+            # accepted = drafts the MODEL agreed with (pre-EOS/budget
+            # truncation): the honest acceptance signal for adaptation —
+            # end-of-generation budget clipping is not a drafting miss
+            n_acc = jnp.sum(acc.astype(jnp.int32), axis=1)
+        return (
+            pool, new_logits, cand, emit, new_done, new_len, new_emitted,
+            n_acc,
+        )
+
+    return jax.jit(verify_fn, donate_argnums=(0, 1))
+
+
 def make_copy_page_program():
     """The jitted copy-on-write primitive: duplicate one page so an
     admission landing on a partially-shared cached page gets a private
@@ -270,6 +405,14 @@ class Request:
     evictions: int = 0
     cached_tokens: int = 0  # prompt tokens served from the prefix cache
     # (summed over admissions — re-admissions typically re-hit)
+    # speculative decoding (engine speculate > 0): current adaptive draft
+    # length, trailing acceptance EWMA, and lifetime draft accounting.
+    # spec_k survives eviction/re-admission — the controller state is a
+    # property of the request's text, not the slot it lands in.
+    spec_k: int = 0
+    spec_rate: float = 1.0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def done(self) -> bool:
@@ -303,6 +446,18 @@ class ServingEngine:
     more than one chunk. ``prefill_chunk=None`` keeps the monolithic
     behavior (the whole uncached suffix in one dispatch).
 
+    Self-speculative decoding (``speculate=N``, greedy only): every
+    decode dispatch becomes a VERIFY dispatch — a host-side n-gram
+    proposer (``serving.speculate.NgramProposer``, injectable via
+    ``proposer=``) drafts up to N tokens per request from its own
+    history, and one jitted program scores the ``N+1`` candidate rows of
+    every slot against the resident pages, emitting ``1 + accepted``
+    tokens per slot per dispatch. Draft length adapts per request to its
+    trailing acceptance rate. Rejected rows' K/V never lands (the write
+    scatter is masked at the per-slot watermark), so allocator/index
+    invariants are untouched and greedy output is token-identical to
+    ``speculate=0``.
+
     Capacity contract: a request must fit its context in ``block_size``
     (prompts are cropped to ``block_size - max_new_tokens`` like the
     reference sampler crops to the window, sample.py:74).
@@ -325,6 +480,8 @@ class ServingEngine:
         prefix_cache: bool = True,
         prefill_chunk: tp.Optional[int] = None,
         prefill_budget: tp.Optional[int] = None,
+        speculate: int = 0,
+        proposer: tp.Optional[Proposer] = None,
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
     ):
@@ -359,6 +516,26 @@ class ServingEngine:
             if prefill_budget is not None
             else prefill_chunk  # None (monolithic) -> unlimited
         )
+        assert speculate >= 0, speculate
+        if speculate:
+            # acceptance is argmax agreement — exact for greedy, with no
+            # exact analogue under temperature sampling (a rejection-
+            # sampling scheme would change the carried-key discipline)
+            assert temperature == 0.0, (
+                "speculative decoding (speculate > 0) is greedy-only; "
+                "set temperature=0.0 or speculate=0"
+            )
+            assert speculate < self.block, speculate
+        self.speculate = int(speculate)
+        self.proposer: tp.Optional[Proposer] = (
+            proposer
+            if proposer is not None
+            else (NgramProposer() if speculate else None)
+        )
+        # tokens a decode dispatch may write per slot: K for the plain
+        # window, spec_len + 1 candidate rows for the verify program —
+        # page growth provisions this many
+        self._grow = (self.speculate + 1) if self.speculate else window
         self.pool = PagedKVPool.init(cfg, num_pages, page_size, cache_dtype)
         self.logits = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
         self._key = jax.random.PRNGKey(seed)
@@ -402,17 +579,32 @@ class ServingEngine:
         self.finished: tp.Dict[int, Request] = {}
         self._next_rid = 0
 
-        self._window_fn = make_decode_window(
-            model,
-            slots=slots,
-            window=window,
-            pmax=self.pmax,
-            rope_len=self.block,
-            pad_id=pad_id,
-            temperature=temperature,
-            top_k=top_k,
-            mesh=mesh,
-        )
+        if self.speculate:
+            # speculation REPLACES the K-step window: every decode
+            # dispatch is a verify dispatch (1 + accepted tokens/slot)
+            self._verify_fn = make_verify_program(
+                model,
+                slots=slots,
+                spec_len=self.speculate,
+                pmax=self.pmax,
+                rope_len=self.block,
+                pad_id=pad_id,
+                mesh=mesh,
+            )
+            self._window_fn = None
+        else:
+            self._verify_fn = None
+            self._window_fn = make_decode_window(
+                model,
+                slots=slots,
+                window=window,
+                pmax=self.pmax,
+                rope_len=self.block,
+                pad_id=pad_id,
+                temperature=temperature,
+                top_k=top_k,
+                mesh=mesh,
+            )
         self._chunk_fns: tp.Dict[int, tp.Any] = {}
         self._copy_fn = make_copy_page_program()
 
@@ -428,6 +620,9 @@ class ServingEngine:
         self.prompt_tokens_cached = 0
         self.prefill_tokens_computed = 0
         self.cold_reclaims = 0
+        self.verify_dispatches = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     # -- submission ---------------------------------------------------------
 
@@ -469,6 +664,7 @@ class ServingEngine:
                 eos_id=-1 if eos_id is None else int(eos_id),
                 seed=seed,
                 submit_time=self.clock(),
+                spec_k=self.speculate,
             )
         )
         return rid
@@ -741,7 +937,7 @@ class ServingEngine:
             # table), and demanding those pages would crash or evict
             # healthy requests for tokens that will never be written
             remaining = int(self.budget[s]) - int(self.emitted[s])
-            tokens = int(self.pooled_len[s]) + min(self.window, remaining)
+            tokens = int(self.pooled_len[s]) + min(self._grow, remaining)
             need = min(
                 pages_needed(tokens, self.page_size), self.pmax
             ) - len(self.slot_pages[s])
@@ -759,6 +955,108 @@ class ServingEngine:
                 self.slot_pages[s].extend(pages)
                 self.bt[s, start : start + need] = pages
 
+    # -- speculative drafting -----------------------------------------------
+
+    def _draft(
+        self, decoding: tp.List[int]
+    ) -> tp.Tuple[np.ndarray, np.ndarray]:
+        """Host-side n-gram drafts for this verify dispatch: up to
+        ``req.spec_k`` (the slot's ADAPTIVE draft length) guesses for the
+        tokens FOLLOWING the pending next token, suffix-matched from the
+        request's own prompt+generated history. Slots with no usable
+        match ride with ``n_draft = 0`` — the dispatch degrades to plain
+        one-token decode for them, never stalls them."""
+        drafts = np.zeros((self.slots, self.speculate), np.int32)
+        n_draft = np.zeros((self.slots,), np.int32)
+        for s in decoding:
+            req = self.slot_req[s]
+            # clamp to the remaining budget: row 0 takes one of the
+            # request's `remaining` tokens, so only remaining-1 drafts
+            # can ever be emitted — rows past that would run the full
+            # model and be discarded by the in-program budget mask
+            remaining = int(self.budget[s]) - int(self.emitted[s])
+            k = min(req.spec_k, self.speculate, remaining - 1)
+            if k < 1:
+                continue
+            got = self.proposer.propose(self.slot_ctx[s], k)
+            got = got[: self.speculate]
+            drafts[s, : len(got)] = got
+            n_draft[s] = len(got)
+        return drafts, n_draft
+
+    def _adapt_spec(self, req: Request, drafted: int, accepted: int) -> None:
+        """Per-request draft-length controller: track a trailing
+        acceptance-rate EWMA and size the next draft to it — a request in
+        a repetitive region climbs back to the full ``speculate``, one in
+        novel text decays toward 1 (cheap single-draft probes keep the
+        estimate live, so recovery is automatic)."""
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        req.spec_drafted += drafted
+        req.spec_accepted += accepted
+        if drafted < 1:
+            return
+        rate = accepted / drafted
+        req.spec_rate = 0.5 * req.spec_rate + 0.5 * rate
+        req.spec_k = max(
+            1,
+            min(
+                self.speculate,
+                int(round(1 + req.spec_rate * (self.speculate - 1))),
+            ),
+        )
+
+    def _run_verify(self, decoding: tp.List[int]) -> None:
+        """One speculative verify dispatch + harvest (the spec-mode
+        replacement for the K-step decode window)."""
+        drafts, n_draft = self._draft(decoding)
+        (
+            self.pool, self.logits, cand, emit, done_d, new_len,
+            emitted_d, n_acc,
+        ) = self._verify_fn(
+            self.pool,
+            self.logits,
+            jnp.asarray(self.bt),
+            jnp.asarray(self.pooled_len),
+            jnp.asarray(self.done),
+            jnp.asarray(self.emitted),
+            jnp.asarray(self.budget),
+            jnp.asarray(self.eos),
+            jnp.asarray(drafts),
+            jnp.asarray(n_draft),
+        )
+        self.decode_dispatches += 1
+        self.verify_dispatches += 1
+        self.windows += 1
+        self.occupancy_sum += len(decoding)
+
+        # ONE device->host sync per dispatch: the [S, T] outputs
+        cand_h = np.asarray(cand)
+        emit_h = np.asarray(emit)
+        n_acc_h = np.asarray(n_acc)
+        self.done = np.array(done_d)
+        self.pooled_len = np.array(new_len, np.int32)
+        self.emitted = np.array(emitted_d, np.int32)
+        now = self.clock()
+        for s in decoding:
+            req = self.slot_req[s]
+            new = [
+                int(cand_h[s, j])
+                for j in range(self.speculate + 1)
+                if emit_h[s, j]
+            ]
+            if new and req.first_token_time is None:
+                req.first_token_time = now
+            req.tokens.extend(new)
+            self.slot_ctx[s].extend(new)
+            self.tokens_generated += len(new)
+            self._adapt_spec(req, int(n_draft[s]), int(n_acc_h[s]))
+            self._register_pages(s)
+            if self.done[s]:
+                req.finish_time = now
+                self.finished[req.rid] = req
+                self._release_slot(s)
+
     def step(self) -> bool:
         """One scheduler window. Returns True while there is (or was) work."""
         self._admit()
@@ -770,6 +1068,10 @@ class ServingEngine:
         self._ensure_growth()
         decoding = self._decoding_slots()  # eviction may have changed it
         if not decoding:
+            return True
+
+        if self.speculate:
+            self._run_verify(decoding)
             return True
 
         (
@@ -909,5 +1211,11 @@ class ServingEngine:
             ),
             "tokens_per_dispatch": round(
                 self.tokens_generated / max(1, self.decode_dispatches), 2
+            ),
+            "verify_dispatches": self.verify_dispatches,
+            "spec_drafted_tokens": self.spec_drafted,
+            "spec_accepted_tokens": self.spec_accepted,
+            "spec_acceptance_rate": round(
+                self.spec_accepted / max(1, self.spec_drafted), 4
             ),
         }

@@ -352,7 +352,17 @@ def flush_recent(
     rows form a prefix: the window carries monotone done flags, so a
     finished slot's tail rows are pad). Invalid rows are routed to the
     out-of-range page sentinel and dropped by ``mode="drop"`` — finished
-    and empty slots cost nothing and corrupt nothing."""
+    and empty slots cost nothing and corrupt nothing.
+
+    This valid-prefix mask is also the speculative-decoding WRITE
+    WATERMARK (serving.engine.make_verify_program): a verify dispatch
+    computes K/V for all ``spec_len + 1`` candidate rows but passes
+    ``valid`` rows only up to the accepted count, so a rejected draft's
+    K/V is dropped right here — it never reaches a page, the pool's
+    resident length (``start_len``) only ever advances over verified
+    context, and the prefix index (which registers pages strictly below
+    that watermark) can never serve speculative garbage to another
+    request."""
     l, s, hkv, kk, c = rk.shape
     ps = pool.page_size
     pmax = bt.shape[1]

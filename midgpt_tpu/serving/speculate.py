@@ -1,0 +1,96 @@
+"""Draft-model-free speculative drafting: prompt-lookup n-gram proposer.
+
+Speculative decoding raises tokens-per-forward by guessing a short
+continuation cheaply and letting the model VERIFY all guesses in one
+multi-query dispatch (serving.engine.make_verify_program). The classic
+formulation needs a second, smaller draft model; this module implements
+the draft-model-free variant (prompt lookup / self-speculation): the
+draft for a request is read out of the request's OWN token history —
+find the most recent earlier occurrence of the current context suffix
+and propose the tokens that followed it.
+
+Why this works on serving traffic: the workloads worth speculating on
+are exactly the ones with internal repetition — summarization and
+code-edit loops quoting their input, chat turns echoing the system
+prompt, grammar-constrained output, greedy models falling into refrains.
+On such text the n-gram continuation matches the model's own argmax for
+several tokens at a stretch; on novel text it misses and the engine's
+adaptive controller shrinks the draft to a cheap 1-token probe. Either
+way the proposal is free of model FLOPs and composes with every config —
+there is no second model to shard, checkpoint, or keep in HBM.
+
+Determinism: proposals are a pure function of the context token list, so
+the engine's greedy output is token-identical to the non-speculative
+path regardless of what is proposed — acceptance verifies against the
+model's own argmax before anything is emitted. A bad proposer costs
+throughput, never correctness (property-tested with an adversarial
+proposer in tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+
+class Proposer(tp.Protocol):
+    """Drafting interface the engine calls once per verify dispatch."""
+
+    def propose(
+        self, ctx: tp.Sequence[int], n: int
+    ) -> tp.List[int]:
+        """Up to ``n`` draft tokens for context positions ``len(ctx)+1,
+        len(ctx)+2, ...`` — i.e. the tokens FOLLOWING the pending next
+        token (the engine materializes position ``len(ctx)`` itself, in-
+        program, from the carried logits). Fewer than ``n`` (including
+        zero) is fine: the verify dispatch masks the missing rows."""
+        ...
+
+
+class NgramProposer:
+    """Prompt-lookup drafting: suffix-match the context against itself.
+
+    ``propose`` scans for the most recent PRIOR occurrence of the
+    longest context suffix of length ``max_ngram`` down to ``min_ngram``
+    and returns the tokens that followed that occurrence. The first
+    continuation token is skipped — it is the proposer's implicit guess
+    for the pending next token, whose true value the verify program
+    computes itself (argmax of the carried logits) and uses as candidate
+    row 0; the returned drafts fill rows 1..n. When the guess is wrong
+    the drafts simply fail verification — alignment is a throughput bet,
+    not a correctness assumption.
+
+    Pure host-side string matching over a few thousand ints per request
+    per dispatch — O(len(ctx) * max_ngram) worst case, microseconds next
+    to an XLA launch. No state is kept between calls, so eviction and
+    re-admission need no bookkeeping here.
+    """
+
+    def __init__(self, max_ngram: int = 4, min_ngram: int = 1):
+        assert max_ngram >= min_ngram >= 1, (max_ngram, min_ngram)
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, ctx: tp.Sequence[int], n: int) -> tp.List[int]:
+        assert n >= 1, n
+        toks = [int(t) for t in ctx]
+        l = len(toks)
+        for k in range(min(self.max_ngram, l - 1), self.min_ngram - 1, -1):
+            suffix = toks[l - k :]
+            best: tp.List[int] = []
+            # scan match starts right to left (recency wins ties),
+            # excluding the suffix's own position; a match whose
+            # continuation fills the whole draft returns immediately,
+            # otherwise the longest partial continuation at this k wins
+            for i in range(l - k - 1, -1, -1):
+                if toks[i : i + k] == suffix:
+                    # continuation after the match; [0] is the pending
+                    # next token's position (row 0 of the verify
+                    # dispatch) — drafts start one past it
+                    cont = toks[i + k : i + k + n + 1]
+                    if len(cont) == n + 1:
+                        return cont[1:]
+                    if len(cont) > len(best):
+                        best = cont
+            if len(best) >= 2:
+                return best[1 : n + 1]
+        return []

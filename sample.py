@@ -97,6 +97,12 @@ def main() -> None:
         "default monolithic",
     )
     ap.add_argument(
+        "--serve_spec", type=_positive_int, default=None,
+        help="self-speculative decoding draft length in --serve mode "
+        "(n-gram prompt-lookup drafts verified in one dispatch; greedy "
+        "only — requires --temperature 0). Default off.",
+    )
+    ap.add_argument(
         "--no_prefix_cache", action="store_true",
         help="disable prefix-cache page sharing in --serve mode",
     )
@@ -176,6 +182,11 @@ def main() -> None:
     if args.serve:
         from midgpt_tpu.serving import generate_served
 
+        if args.serve_spec and args.temperature != 0.0:
+            raise SystemExit(
+                "--serve_spec requires greedy decoding (--temperature 0): "
+                "speculative acceptance is argmax agreement"
+            )
         outs = generate_served(
             model,
             [prompt[i] for i in range(args.num_samples)],
@@ -187,6 +198,7 @@ def main() -> None:
             page_size=args.serve_page_size,
             prefix_cache=not args.no_prefix_cache,
             prefill_chunk=args.serve_prefill_chunk,
+            speculate=args.serve_spec or 0,
             seed=args.seed,
             mesh=mesh,
         )

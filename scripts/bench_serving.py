@@ -13,6 +13,17 @@ clock, and emits ONE JSON record:
   serve_prefix_hit_rate  prompt tokens served from the prefix cache
   serve_prefill_tokens_saved / serve_prefill_tokens_computed
   serve_cow_copies       copy-on-write page duplications
+  serve_spec_acceptance_rate  drafted tokens the model's argmax accepted
+  serve_verify_dispatches     speculative verify dispatches
+
+Self-speculative decoding (--spec on, greedy only): every decode
+dispatch drafts up to --spec_len tokens per request by n-gram lookup
+over the request's own history and verifies them in one dispatch —
+serve_tokens_per_dispatch is the headline (1 + E[accepted] tokens per
+launch vs exactly 1 for --spec off at --window 1). Pair it with
+--repetitive, which tiles each prompt from a short random pattern (the
+self-repeating traffic shape prompt-lookup drafting exists for); random
+incompressible prompts keep acceptance (and the win) near zero.
 
 A shared-system-prompt mix (--sys_prompt_len N) prepends one fixed
 N-token prefix to --sys_prompt_frac of all requests — the dominant
@@ -68,6 +79,14 @@ def main() -> None:
                     "--sys_prompt_frac of requests (0 = independent "
                     "prompts)")
     ap.add_argument("--sys_prompt_frac", type=float, default=1.0)
+    ap.add_argument("--spec", choices=("on", "off"), default="off",
+                    help="self-speculative decoding (n-gram drafting + "
+                    "single-dispatch verification; greedy only)")
+    ap.add_argument("--spec_len", type=int, default=8,
+                    help="max draft tokens per verify dispatch (--spec on)")
+    ap.add_argument("--repetitive", action="store_true",
+                    help="tile each prompt from a short random pattern — "
+                    "the self-repeating workload n-gram drafting targets")
     ap.add_argument("--out", default=None,
                     help="output JSON path (default "
                     "artifacts/bench_serving.json; the r6 queue's K-ladder "
@@ -115,10 +134,21 @@ def main() -> None:
         0, cfg.vocab_size, size=args.sys_prompt_len
     ).astype(np.int32)
     shared_mask = rng.random(args.requests) < args.sys_prompt_frac
-    prompts = [
-        rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
-        for p in plens
-    ]
+    if args.repetitive:
+        # self-repeating prompts: a short pattern tiled to length — the
+        # n-gram proposer finds the period and drafts whole repeats
+        def rep_prompt(p):
+            pat = rng.integers(
+                0, cfg.vocab_size, size=max(2, int(p) // 8)
+            ).astype(np.int32)
+            return np.tile(pat, -(-int(p) // pat.size))[: int(p)]
+
+        prompts = [rep_prompt(p) for p in plens]
+    else:
+        prompts = [
+            rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
+            for p in plens
+        ]
     if args.sys_prompt_len:
         assert args.sys_prompt_len + args.max_prompt + args.max_new <= (
             cfg.block_size
@@ -137,6 +167,7 @@ def main() -> None:
         seed=args.seed,
         prefix_cache=args.prefix_cache == "on",
         prefill_chunk=args.prefill_chunk or None,
+        speculate=args.spec_len if args.spec == "on" else 0,
     )
 
     # warmup: compile the decode window + EVERY prefill-chunk bucket the
@@ -154,7 +185,8 @@ def main() -> None:
                  "copy_dispatches", "tokens_generated", "windows",
                  "occupancy_sum", "evictions", "prompt_tokens_total",
                  "prompt_tokens_cached", "prefill_tokens_computed",
-                 "cold_reclaims"):
+                 "cold_reclaims", "verify_dispatches", "spec_drafted",
+                 "spec_accepted"):
         setattr(eng, attr, 0)
 
     t0 = time.monotonic()
@@ -186,7 +218,9 @@ def main() -> None:
             f"{args.preset} S={args.slots} K={args.window} "
             f"page={args.page_size} cache={args.prefix_cache} "
             f"chunk={args.prefill_chunk or 'mono'} "
-            f"sys={args.sys_prompt_len}"
+            f"sys={args.sys_prompt_len} "
+            f"spec={args.spec_len if args.spec == 'on' else 'off'}"
+            f"{' rep' if args.repetitive else ''}"
         ),
         "serve_requests": args.requests,
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
@@ -205,6 +239,10 @@ def main() -> None:
         "serve_prefill_tokens_computed": st["prefill_tokens_computed"],
         "serve_cow_copies": st["copy_dispatches"],
         "serve_cold_reclaims": st["cold_reclaims"],
+        "serve_verify_dispatches": st["verify_dispatches"],
+        "serve_spec_drafted_tokens": st["spec_drafted_tokens"],
+        "serve_spec_accepted_tokens": st["spec_accepted_tokens"],
+        "serve_spec_acceptance_rate": st["spec_acceptance_rate"],
     }
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")

@@ -15,7 +15,12 @@ SENT=/tmp/r6_probe_ok
 rm -f "$SENT"
 
 probe() {
-  nohup python -c "
+  # pin the probe to the TPU backend: on a CPU-only box jax would
+  # otherwise fall back to CPU, "succeed", and start the whole TPU
+  # pipeline on the host CPU. Pinned, a no-TPU probe exits nonzero
+  # (respawned every poll until hardware appears) and a wedged relay
+  # hangs it harmlessly, exactly as before.
+  nohup env JAX_PLATFORMS=tpu python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256,256), jnp.bfloat16)
 float((x@x)[0,0])
@@ -68,6 +73,21 @@ run serving_sys_cache python scripts/bench_serving.py --platform=tpu \
 run serving_sys_chunked python scripts/bench_serving.py --platform=tpu \
   --sys_prompt_len 256 --max_prompt 128 --prefill_chunk 128 \
   --out artifacts/bench_serving_sys_chunked.json
+# Self-speculative decoding ladder (PR 5) on a repetitive-text mix (the
+# workload n-gram drafting targets): identical trace with speculation
+# off vs on — serve_tokens_per_dispatch and serve_spec_acceptance_rate
+# quantify tokens-per-forward, serve_tok_s the end-to-end win. The
+# window-1 off rung is the one-token-per-forward baseline the PERF.md
+# speedup arithmetic is stated against.
+run serving_spec_base python scripts/bench_serving.py --platform=tpu \
+  --repetitive --window 1 --spec off \
+  --out artifacts/bench_serving_spec_base.json
+run serving_spec_off python scripts/bench_serving.py --platform=tpu \
+  --repetitive --window 8 --spec off \
+  --out artifacts/bench_serving_spec_off.json
+run serving_spec_on python scripts/bench_serving.py --platform=tpu \
+  --repetitive --spec on --spec_len 8 \
+  --out artifacts/bench_serving_spec_on.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -1,8 +1,12 @@
 """Serving engine (midgpt_tpu.serving): page-allocator invariants, paged
 decode parity against the exact sampler, fused K-step window vs K=1
-(including EOS inside a window), and scheduler admit/evict behavior under
-scripted traces. Beyond the reference (its sampler is fixed-batch,
-full-re-forward per token, sample.py:68-95)."""
+(including EOS inside a window), scheduler admit/evict behavior under
+scripted traces, prefix-cache/chunked-prefill exactness, and
+self-speculative decoding (n-gram drafting + single-dispatch
+verification: token identity vs spec-off, dispatch accounting, and
+watermark-rollback invariants under forced full rejection). Beyond the
+reference (its sampler is fixed-batch, full-re-forward per token,
+sample.py:68-95)."""
 
 import dataclasses
 
@@ -62,6 +66,32 @@ def _exact(model, prompt, n_new):
             cache_dtype=jnp.float32,
         )
     )[0]
+
+
+@pytest.fixture(scope="module")
+def shared_prefix_case():
+    """Shared-prefix trace + exact-sampler refs, computed once: the
+    prefix-cache/chunking identity test and the speculative identity
+    matrix drive the same requests (each _exact call compiles its own
+    sampler, so recomputing per test is pure wall-clock)."""
+    model = _model()
+    sys_prompt = _prompts(1, base_len=18)[0]
+    tails = _prompts(4, base_len=3, stride=2)
+    prompts = [np.concatenate([sys_prompt, t]) for t in tails]
+    lens = [9, 12, 7, 10]
+    refs = [_exact(model, p, n) for p, n in zip(prompts, lens)]
+    return model, prompts, lens, refs
+
+
+@pytest.fixture(scope="module")
+def eviction_case():
+    """Equal-length eviction-pressure trace + refs at the two generation
+    lengths the eviction tests use (16 and 24), computed once."""
+    model = _model()
+    prompts = _prompts(4, base_len=6, stride=0)
+    refs16 = [_exact(model, p, 16) for p in prompts]
+    refs24 = [_exact(model, p, 24) for p in prompts]
+    return model, prompts, refs16, refs24
 
 
 # ---------------------------------------------------------------------------
@@ -312,13 +342,11 @@ def test_scheduler_scripted_arrival_trace():
         assert req.finish_time >= req.first_token_time
 
 
-def test_scheduler_evicts_under_page_pressure_and_recovers():
+def test_scheduler_evicts_under_page_pressure_and_recovers(eviction_case):
     """A pool too small for all requests at once forces eviction; evicted
     requests re-queue with progress kept and still finish with exact
     parity."""
-    model = _model()
-    prompts = _prompts(4, base_len=6, stride=0)
-    refs = [_exact(model, p, 16) for p in prompts]
+    model, prompts, refs, _ = eviction_case
     eng = ServingEngine(
         model, slots=2, page_size=8, num_pages=5, window=4,
         temperature=0.0, cache_dtype=jnp.float32,
@@ -354,15 +382,15 @@ def test_steady_state_one_dispatch_per_k_tokens():
     assert st["slot_occupancy"] == 1.0
 
 
-def test_repeated_eviction_rebuilds_context_without_duplication():
+def test_repeated_eviction_rebuilds_context_without_duplication(
+    eviction_case,
+):
     """Regression (code review): a request evicted TWICE must rebuild its
     admission context from the original prompt + all generated tokens —
     appending to an already-grown prompt duplicated the first eviction's
     tokens, corrupting the context and livelocking tight pools."""
-    model = _model()
-    prompts = _prompts(4, base_len=6, stride=0)
+    model, prompts, _, refs = eviction_case
     n_new = 24  # long generations -> many growth events -> re-evictions
-    refs = [_exact(model, p, n_new) for p in prompts]
     eng = ServingEngine(
         model, slots=2, page_size=8, num_pages=5, window=4,
         temperature=0.0, cache_dtype=jnp.float32,
@@ -435,17 +463,12 @@ def test_engine_rejects_oversized_requests():
 # ---------------------------------------------------------------------------
 
 
-def test_prefix_cache_and_chunking_token_identity():
+def test_prefix_cache_and_chunking_token_identity(shared_prefix_case):
     """Acceptance: greedy output is token-identical per request with the
     prefix cache on vs off and with chunked vs monolithic prefill —
     shared-prefix traffic, mid-run admission (more requests than slots),
     all against the exact fixed-batch sampler."""
-    model = _model()
-    sys_prompt = _prompts(1, base_len=18)[0]
-    tails = _prompts(4, base_len=3, stride=2)
-    prompts = [np.concatenate([sys_prompt, t]) for t in tails]
-    lens = [9, 12, 7, 10]
-    refs = [_exact(model, p, n) for p, n in zip(prompts, lens)]
+    model, prompts, lens, refs = shared_prefix_case
 
     def run(prefix_cache, prefill_chunk):
         eng = ServingEngine(
@@ -539,14 +562,12 @@ def test_multiturn_hits_decode_written_pages_with_parity():
     np.testing.assert_array_equal(np.asarray(toks_b_on), ref)
 
 
-def test_eviction_readmission_rehits_cache_with_parity():
+def test_eviction_readmission_rehits_cache_with_parity(eviction_case):
     """Under page pressure an evicted request's pages retire COLD; its
     re-admission re-prefills via cache hits (tokens saved > 0) and the
     output still matches the exact sampler bit-for-bit."""
-    model = _model()
-    prompts = _prompts(4, base_len=6, stride=0)
+    model, prompts, _, refs = eviction_case
     n_new = 24
-    refs = [_exact(model, p, n_new) for p in prompts]
     eng = ServingEngine(
         model, slots=2, page_size=8, num_pages=5, window=4,
         temperature=0.0, cache_dtype=jnp.float32, prefix_cache=True,
@@ -703,6 +724,298 @@ def test_allocator_refcount_never_negative():
     a.incref(q)
     assert a.refcount(q) == 1 and a.cached_pages == 0
     a.check()
+
+
+# ---------------------------------------------------------------------------
+# Self-speculative decoding: n-gram drafting + single-dispatch verification
+# ---------------------------------------------------------------------------
+
+
+class _OracleProposer:
+    """Test proposer that drafts the TRUE greedy continuation (known from
+    a spec-off reference run) — every draft verifies, so dispatch counts
+    hit their floor deterministically."""
+
+    def __init__(self, seqs):
+        # seqs: list of full token lists (prompt + greedy continuation)
+        self.seqs = [[int(t) for t in s] for s in seqs]
+
+    def propose(self, ctx, n):
+        ctx = [int(t) for t in ctx]
+        for full in self.seqs:
+            if full[: len(ctx)] == ctx and len(full) > len(ctx) + 1:
+                return full[len(ctx) + 1 : len(ctx) + 1 + n]
+        return []
+
+
+class _AntiOracleProposer(_OracleProposer):
+    """Adversarial proposer: drafts are the true continuation shifted by
+    one token id — every draft is guaranteed WRONG, so every verify
+    dispatch fully rejects (the watermark-rollback worst case)."""
+
+    def propose(self, ctx, n):
+        good = super().propose(ctx, n)
+        return [(t + 1) % CFG.vocab_size for t in good]
+
+
+def test_ngram_proposer_periodic_and_no_match():
+    from midgpt_tpu.serving import NgramProposer
+
+    p = NgramProposer(max_ngram=3, min_ngram=1)
+    # periodic context: the suffix [2, 3] recurs; the continuation chain
+    # after the match predicts positions len(ctx)+1.. (the engine's row 0
+    # covers position len(ctx) itself, so drafts skip one token)
+    ctx = [1, 2, 3, 1, 2, 3, 1, 2, 3]
+    # suffix match predicts next = 1 (skipped), then 2, 3, 1, ...
+    assert p.propose(ctx, 4) == [2, 3, 1, 2]
+    # all-distinct context: nothing recurs, no drafts
+    assert p.propose(list(range(10, 30)), 4) == []
+    # too-short context: no earlier occurrence exists
+    assert p.propose([5], 4) == []
+    # constant runs: drafts are read out of history verbatim (no
+    # extrapolation), so a short run yields what the earliest match can
+    # see and a long run fills the whole draft
+    assert p.propose([7, 7, 7, 7], 3) == [7]
+    assert p.propose([7] * 8, 3) == [7, 7, 7]
+
+
+def test_spec_token_identity_matrix(shared_prefix_case):
+    """Acceptance: greedy output with speculation on is token-identical
+    to the non-speculative engine across prefix-cache on/off x chunked
+    vs monolithic prefill — shared-prefix traffic, mid-run admission —
+    and to the exact fixed-batch sampler."""
+    model, prompts, lens, refs = shared_prefix_case
+
+    def run(speculate, prefix_cache, prefill_chunk):
+        eng = ServingEngine(
+            model, slots=2, page_size=8, window=4, temperature=0.0,
+            cache_dtype=jnp.float32, prefix_cache=prefix_cache,
+            prefill_chunk=prefill_chunk, speculate=speculate,
+        )
+        rids = [eng.submit(p, n) for p, n in zip(prompts, lens)]
+        fin = eng.run()
+        eng.alloc.check()
+        if eng.index is not None:
+            eng.index.check(eng.alloc)
+        assert eng.alloc.held_pages == 0
+        return [fin[r].tokens for r in rids]
+
+    # the spec-off engine == exact-sampler identity across these axes is
+    # PR 4's test_prefix_cache_and_chunking_token_identity; here the
+    # refs ARE the spec-off streams, so comparing each spec-on variant
+    # to them is exactly spec-on vs spec-off (one engine run per variant)
+    base = [list(map(int, r)) for r in refs]
+    # two spec-on variants span both cache states and both prefill modes
+    # (each distinct spec_len would compile its own verify program;
+    # runtime draft-length variation is covered by the adaptive
+    # controller, which the full-rejection test drives to its floor)
+    for variant in [(4, True, None), (4, False, 8)]:
+        assert run(*variant) == base, f"variant {variant} diverged"
+
+
+def test_spec_identity_under_eviction_and_readmission(eviction_case):
+    """Speculation x page pressure: evicted requests re-queue, re-admit
+    (through the prefix cache), and keep speculating — output still
+    matches the exact sampler bit-for-bit and pages all come home."""
+    model, prompts, refs, _ = eviction_case
+    n_new = 16  # 3 pages per request x 2 slots > the 5-page pool
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=5, window=4,
+        temperature=0.0, cache_dtype=jnp.float32, prefix_cache=True,
+        speculate=4,
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    fin = eng.run()
+    assert eng.evictions > 0, "trace was sized to force eviction"
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(fin[r].tokens), refs[i], err_msg=f"request {i}"
+        )
+    eng.alloc.check()
+    eng.index.check(eng.alloc)
+    assert eng.alloc.held_pages == 0
+
+
+def test_spec_dispatch_accounting_on_repetitive_prompt():
+    """Acceptance: on a repetitive-text prompt the n-gram proposer's
+    drafts verify, so a single slot emits MORE than one token per decode
+    dispatch — with the stream still identical to spec-off."""
+    model = _model()
+    pat = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(500), (4,), 0, CFG.vocab_size)
+    )
+    prompt = np.tile(pat, 6)  # 24 tokens of period-4 text
+    n_new = 20
+    ref = _exact(model, prompt, n_new)
+    eng = ServingEngine(
+        model, slots=1, page_size=8, window=4, temperature=0.0,
+        cache_dtype=jnp.float32, speculate=4,
+    )
+    rid = eng.submit(prompt, n_new)
+    fin = eng.run()
+    np.testing.assert_array_equal(np.asarray(fin[rid].tokens), ref)
+    st = eng.stats()
+    assert st["tokens_generated"] == n_new
+    assert st["decode_dispatches"] < n_new, st
+    assert st["tokens_per_dispatch"] > 1.0, st
+    assert st["spec_accepted_tokens"] > 0
+    assert st["verify_dispatches"] == st["decode_dispatches"]
+    # spec-off at window=1 pays exactly one dispatch per token: the
+    # speculative engine provably beat one-token-per-forward
+    assert st["decode_dispatches"] < len(ref)
+
+
+@pytest.mark.slow
+def test_spec_oracle_hits_dispatch_floor():
+    """With a perfect proposer the dispatch count hits its deterministic
+    floor: ceil(n_new / (spec_len + 1)) verify dispatches per request."""
+    model = _model()
+    prompts = _prompts(2, base_len=5, stride=0)  # equal length: 1 batch
+    n_new, spec = 12, 4
+    refs = np.asarray(
+        generate(
+            model, jnp.stack([jnp.asarray(p) for p in prompts]), n_new,
+            key=jax.random.PRNGKey(9), temperature=0.0,
+            cache_dtype=jnp.float32,
+        )
+    )
+    seqs = [
+        list(map(int, p)) + list(map(int, r)) for p, r in zip(prompts, refs)
+    ]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, temperature=0.0,
+        cache_dtype=jnp.float32, speculate=spec,
+        proposer=_OracleProposer(seqs),
+    )
+    rids = [eng.submit(p, n_new) for p in prompts]
+    fin = eng.run()
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(np.asarray(fin[r].tokens), refs[i])
+    st = eng.stats()
+    assert st["decode_dispatches"] == -(-n_new // (spec + 1))  # 12 -> 3
+    assert st["tokens_per_dispatch"] == 2 * n_new / 3  # both slots
+    assert st["spec_acceptance_rate"] == 1.0
+    # full acceptance keeps every request's adaptive draft length maxed
+    assert all(fin[r].spec_k == spec for r in rids)
+
+
+def test_spec_full_rejection_watermark_property_loop():
+    """Acceptance: forced FULL-REJECTION verify dispatches (adversarial
+    proposer — every draft wrong) under page pressure, chunked prefill
+    and the prefix cache. After every scheduler step the allocator/index
+    invariants and the single-writer property must hold (rejected rows'
+    K/V never lands, the watermark only advances over verified context),
+    and the final streams still match the exact sampler: a hostile
+    proposer costs throughput, never correctness."""
+    model = _model()
+    prompts = _prompts(4, base_len=6, stride=1)
+    n_new = 12
+    refs = [_exact(model, p, n_new) for p in prompts]
+    seqs = [
+        list(map(int, p)) + list(map(int, r)) for p, r in zip(prompts, refs)
+    ]
+    eng = ServingEngine(
+        model, slots=2, page_size=8, num_pages=6, window=4,
+        temperature=0.0, cache_dtype=jnp.float32, prefix_cache=True,
+        prefill_chunk=8, speculate=4, proposer=_AntiOracleProposer(seqs),
+    )
+    rids = [eng.submit(p, n_new, seed=i) for i, p in enumerate(prompts)]
+    steps = 0
+    while (eng.queue or eng._active_slots()) and steps < 500:
+        eng.step()
+        steps += 1
+        eng.alloc.check()
+        eng.index.check(eng.alloc)
+        for s in eng._active_slots():
+            # the watermark never runs ahead of verified host-side
+            # context (speculative rows beyond it were rolled back)
+            assert int(eng.pooled_len[s]) <= len(eng.slot_ctx[s])
+            for pg in eng.slot_pages[s]:
+                if pg in eng.index:
+                    continue  # full + indexed: immutable, safely shared
+                assert eng.alloc.refcount(pg) == 1, (
+                    f"writer page {pg} shared"
+                )
+                owners = [
+                    v for v in eng._active_slots()
+                    if pg in eng.slot_pages[v]
+                ]
+                assert owners == [s], f"page {pg} aliased by {owners}"
+    assert steps < 500, "engine did not drain"
+    assert eng.spec_drafted > 0, "adversarial drafts never ran"
+    assert eng.spec_accepted == 0, "anti-oracle drafts must all reject"
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(
+            np.asarray(eng.finished[r].tokens), refs[i], err_msg=f"req {i}"
+        )
+    # full rejection decays every request's draft length to the floor
+    assert all(eng.finished[r].spec_k == 1 for r in rids)
+    eng.alloc.check()
+    assert eng.alloc.held_pages == 0
+
+
+@pytest.mark.slow
+def test_spec_eos_mid_verify_matches_spec_off():
+    """An EOS landing inside a verify dispatch (among the accepted rows)
+    truncates the emission at the EOS — same stop point as spec-off."""
+    model = _model()
+    prompt = _prompts(1)[0]
+    ref = _exact(model, prompt, 16)
+    eos = int(ref[len(ref.tolist()) // 2])  # a token the rollout emits
+    off = generate_served(
+        model, [prompt], 16, eos_id=eos, window=4, page_size=8,
+        cache_dtype=jnp.float32,
+    )[0]
+    on = generate_served(
+        model, [prompt], 16, eos_id=eos, window=4, page_size=8,
+        cache_dtype=jnp.float32, speculate=4,
+    )[0]
+    np.testing.assert_array_equal(on, off)
+    assert int(on[-1]) == eos and eos not in on[:-1].tolist()
+
+
+def test_spec_requires_greedy():
+    model = _model()
+    with pytest.raises(AssertionError):
+        ServingEngine(model, slots=1, temperature=0.8, speculate=4)
+
+
+@pytest.mark.slow
+def test_spec_identity_with_bf16_cache_under_f32_model():
+    """Regression (code review): the decode window reads even in-window
+    K/V back through the CACHE-dtype recent buffer, so the verify
+    program must round its in-dispatch self K/V to pool dtype before
+    scoring — an f32 model over a bf16 pool would otherwise compare
+    acceptance argmaxes against un-rounded keys (a far larger gap than
+    the bf16 ulp flips the CLI drive catches). f32-model + bf16-cache is
+    exactly the combination neither the f32/f32 fast tests nor the
+    bf16/bf16 checkpoint drive covers."""
+    model = _model()  # f32 params
+    prompts = _prompts(2)
+    outs = {}
+    for spec in (0, 4):
+        outs[spec] = generate_served(
+            model, prompts, 12, window=4, page_size=8,
+            cache_dtype=jnp.bfloat16, speculate=spec,
+        )
+    for a, b in zip(outs[0], outs[4]):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_verify_program_audit_donation_and_host_sync():
+    """The compiled speculative verify program passes the serving
+    invariants (pool + logits donation intact, no host sync) — with
+    speculation on, every decode dispatch is this program."""
+    from midgpt_tpu.analysis.harness import audit_verify_program
+    from midgpt_tpu.config import get_config
+
+    analysis, report = audit_verify_program(
+        get_config("shakespeare_char"), slots=2, spec_len=4, page_size=8
+    )
+    assert report.ok, report.violations
+    assert analysis.donated_leaves == 3  # pool.k, pool.v, logits
+    assert len({e.param_number for e in analysis.aliases}) >= 3
 
 
 @pytest.mark.slow
