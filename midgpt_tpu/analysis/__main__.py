@@ -95,7 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
         "K-step DECODE window, the suffix-prefill CHUNK, and the "
         "speculative VERIFY program — donation must stay intact (KV "
         "pool + logits alias input->output) and no host sync may hide "
-        "inside any of them; --steps-per-dispatch sets K (default 4)",
+        "inside any of them; each program is then compiled AGAIN on "
+        "the int8 quantized weight path (midgpt_tpu.quant) and must "
+        "additionally pass no-dequant-materialization (int8 streams "
+        "as s8 entry params, dequant fused into each matmul); "
+        "--steps-per-dispatch sets K (default 4)",
     )
     p.add_argument(
         "--serving-slots", type=int, default=4, metavar="S",
@@ -225,10 +229,39 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
         )
-        ok = report.ok and chunk_report.ok and spec_report.ok
+        # the int8 quantized weight path compiles all three programs
+        # again from the SAME _serving_audit_setup geometry and adds the
+        # no-dequant-materialization rule: the int8 arrays must enter as
+        # s8 parameters with the dequant fused into each matmul — a
+        # closed-over or pre-dequantized model silently streams (or
+        # constant-folds to) full-precision weights, undoing the halved
+        # weight stream the quant path pays for
+        quant_reports = {}
+        quant_ok = True
+        for qname, qfn, qkw in (
+            ("decode_window", audit_decode_window, dict(
+                slots=args.serving_slots, window=k,
+                page_size=args.serving_page_size,
+            )),
+            ("prefill_chunk", audit_prefill_chunk, dict(
+                page_size=args.serving_page_size,
+            )),
+            ("verify_program", audit_verify_program, dict(
+                slots=args.serving_slots,
+                spec_len=args.serving_spec_len,
+                page_size=args.serving_page_size,
+            )),
+        ):
+            q_analysis, q_report = qfn(
+                cfg, shrink=not args.no_shrink, quant=True, **qkw
+            )
+            quant_ok = quant_ok and q_report.ok
+            quant_reports[qname] = (q_analysis, q_report)
+        ok = report.ok and chunk_report.ok and spec_report.ok and quant_ok
         out = {
             "config": args.config,
-            "mode": "serving-decode-window+prefill-chunk+verify-program",
+            "mode": "serving-decode-window+prefill-chunk+verify-program"
+            "+quantized",
             "ok": ok,
             "geometry": {
                 "slots": args.serving_slots,
@@ -255,6 +288,16 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                 ),
                 "rules": spec_report.to_dict()["rules"],
             },
+            "quantized": {
+                qname: {
+                    "donated_leaves": qa.donated_leaves,
+                    "aliased_buffers": len(
+                        {e.param_number for e in qa.aliases}
+                    ),
+                    "rules": qr.to_dict()["rules"],
+                }
+                for qname, (qa, qr) in quant_reports.items()
+            },
         }
         text = json.dumps(out, indent=2)
         print(text)
@@ -262,11 +305,17 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             with open(args.json, "w") as f:
                 f.write(text + "\n")
         if not ok:
-            for v in (
+            violations = (
                 report.violations
                 + chunk_report.violations
                 + spec_report.violations
-            ):
+                + tuple(
+                    v
+                    for _, qr in quant_reports.values()
+                    for v in qr.violations
+                )
+            )
+            for v in violations:
                 print(f"VIOLATION {v}", file=sys.stderr)
             return 1
         return 0

@@ -194,12 +194,16 @@ def audit_config(
 
 
 def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
-                         page_size: int, shrink: bool):
+                         page_size: int, shrink: bool,
+                         quant: bool = False):
     """Shared geometry for the three serving audits (decode window +
     prefill chunk + speculative verify): audit-shrunk model config,
     1-device mesh, bf16-cast model, page pool and slot logits. ONE
     definition so the compiled programs can never silently audit
-    different geometries."""
+    different geometries. ``quant=True`` converts the model to the int8
+    quantized serving pytree (midgpt_tpu.quant) and additionally returns
+    its weight-matrix shapes — what the no-dequant-materialization rule
+    is parameterized with (empty when quant is off)."""
     import dataclasses as _dc
 
     import jax
@@ -222,10 +226,35 @@ def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
         devices=jax.devices()[:1],
     )
     model = cast_floating(GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16)
+    wshapes: tp.FrozenSet[tp.Tuple[int, ...]] = frozenset()
+    if quant:
+        from midgpt_tpu.quant import quant_weight_shapes, quantize_model
+
+        model = quantize_model(model)
+        wshapes = quant_weight_shapes(model)
     pmax = pages_needed(model_cfg.block_size, page_size)
     pool = PagedKVPool.init(model_cfg, slots * pmax, page_size)
     logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
-    return model_cfg, mesh, model, pmax, pool, logits
+    return model_cfg, mesh, model, pmax, pool, logits, wshapes
+
+
+def _serving_rules(wshapes) -> "RuleSet":
+    """The serving-invariant ruleset all three program audits share:
+    donation-intact + no-host-sync + no-f64, plus
+    no-dequant-materialization when the program was compiled against the
+    quantized pytree (``wshapes`` non-empty)."""
+    from midgpt_tpu.analysis.rules import (
+        DonationIntact,
+        NoDequantMaterialization,
+        NoF64,
+        NoHostSync,
+        RuleSet,
+    )
+
+    rules = [NoF64(), DonationIntact(), NoHostSync()]
+    if wshapes:
+        rules.append(NoDequantMaterialization(wshapes))
+    return RuleSet(rules)
 
 
 def compile_decode_window(
@@ -235,26 +264,33 @@ def compile_decode_window(
     window: int = 4,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ):
     """Compile the serving engine's fused K-step decode window
     (``midgpt_tpu.serving.make_decode_window``) for ``cfg``'s model —
     the program the engine launches once per K generated tokens. Returns
-    ``(hlo_text, mesh, donated_leaves, audited_block_size)`` — the block
-    size is the AUDITED model's (shrunk when ``shrink``), which is the
-    geometry the HLO was actually compiled at.
+    ``(hlo_text, mesh, donated_leaves, audited_block_size,
+    quant_weight_shapes)`` — the block size is the AUDITED model's
+    (shrunk when ``shrink``), which is the geometry the HLO was actually
+    compiled at; the weight shapes are empty unless ``quant``.
 
     Audited for the same two regressions the K-step train window is:
     donation staying intact across the window (pool + logits buffers must
     alias input->output, or every window holds two copies of the KV pool
     in HBM) and no host sync hiding inside it (one stray callback stalls
-    all K decode steps per launch)."""
+    all K decode steps per launch). ``quant=True`` compiles the int8
+    quantized weight path instead (midgpt_tpu.quant) for the
+    no-dequant-materialization rule."""
     import jax
     import numpy as np_
 
     from midgpt_tpu.serving.engine import make_decode_window
 
-    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
-        cfg, slots=slots, page_size=page_size, shrink=shrink
+    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+        _serving_audit_setup(
+            cfg, slots=slots, page_size=page_size, shrink=shrink,
+            quant=quant,
+        )
     )
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
@@ -262,14 +298,14 @@ def compile_decode_window(
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = window_fn.lower(
-        pool, logits, i32(slots, pmax), i32(slots),
+        model, pool, logits, i32(slots, pmax), i32(slots),
         np_.zeros((slots,), bool), i32(slots), i32(slots), i32(slots),
         i32(slots), jax.random.PRNGKey(1),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
     # return the AUDITED model's block size: with shrink it differs from
     # cfg's, and geometry-dependent rules must see the compiled program's
-    return hlo, mesh, donated_leaves, model_cfg.block_size
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
 
 
 def audit_decode_window(
@@ -279,23 +315,19 @@ def audit_decode_window(
     window: int = 4,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call serving audit: compile the fused decode window and check
-    the serving invariants (donation-intact, no-host-sync, no-f64)."""
-    from midgpt_tpu.analysis.rules import (
-        DonationIntact,
-        NoF64,
-        NoHostSync,
-        RuleSet,
-    )
-
+    the serving invariants (donation-intact, no-host-sync, no-f64 —
+    plus no-dequant-materialization when ``quant``)."""
     cfg = (
         get_config(name_or_cfg)
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block = compile_decode_window(
-        cfg, slots=slots, window=window, page_size=page_size, shrink=shrink
+    hlo, mesh, donated, block, wshapes = compile_decode_window(
+        cfg, slots=slots, window=window, page_size=page_size,
+        shrink=shrink, quant=quant,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -304,9 +336,7 @@ def audit_decode_window(
         block=block,
         donated_leaves=donated,
     )
-    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
-        analysis
-    )
+    report = _serving_rules(wshapes).evaluate(analysis)
     return analysis, report
 
 
@@ -316,6 +346,7 @@ def compile_prefill_chunk(
     chunk_len: int = 64,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ):
     """Compile the serving engine's prefill-chunk program
     (``midgpt_tpu.serving.make_prefill_chunk_program``) — the suffix-only
@@ -336,8 +367,10 @@ def compile_prefill_chunk(
 
     from midgpt_tpu.serving.engine import make_prefill_chunk_program
 
-    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
-        cfg, slots=4, page_size=page_size, shrink=shrink
+    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+        _serving_audit_setup(
+            cfg, slots=4, page_size=page_size, shrink=shrink, quant=quant
+        )
     )
     assert chunk_len <= model_cfg.block_size, (chunk_len, model_cfg.block_size)
     chunk_fn = make_prefill_chunk_program(
@@ -346,10 +379,11 @@ def compile_prefill_chunk(
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = chunk_fn.lower(
-        pool, logits, i32(), i32(1, chunk_len), i32(), i32(), i32(pmax),
+        model, pool, logits, i32(), i32(1, chunk_len), i32(), i32(),
+        i32(pmax),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
-    return hlo, mesh, donated_leaves, model_cfg.block_size
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
 
 
 def audit_prefill_chunk(
@@ -358,26 +392,22 @@ def audit_prefill_chunk(
     chunk_len: int = 64,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call audit of the prefill-chunk program: donation-intact,
-    no-host-sync, no-f64 — the CI serving-audit job runs this next to
+    no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
+    — the CI serving-audit job runs this next to
     :func:`audit_decode_window` so a window containing a mid-window
     prefill chunk (the chunked-prefill steady state) is covered end to
     end."""
-    from midgpt_tpu.analysis.rules import (
-        DonationIntact,
-        NoF64,
-        NoHostSync,
-        RuleSet,
-    )
-
     cfg = (
         get_config(name_or_cfg)
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block = compile_prefill_chunk(
-        cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink
+    hlo, mesh, donated, block, wshapes = compile_prefill_chunk(
+        cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
+        quant=quant,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -386,9 +416,7 @@ def audit_prefill_chunk(
         block=block,
         donated_leaves=donated,
     )
-    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
-        analysis
-    )
+    report = _serving_rules(wshapes).evaluate(analysis)
     return analysis, report
 
 
@@ -399,6 +427,7 @@ def compile_verify_program(
     spec_len: int = 4,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ):
     """Compile the serving engine's speculative VERIFY program
     (``midgpt_tpu.serving.make_verify_program``) — the single dispatch
@@ -420,8 +449,11 @@ def compile_verify_program(
 
     from midgpt_tpu.serving.engine import make_verify_program
 
-    model_cfg, mesh, model, pmax, pool, logits = _serving_audit_setup(
-        cfg, slots=slots, page_size=page_size, shrink=shrink
+    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+        _serving_audit_setup(
+            cfg, slots=slots, page_size=page_size, shrink=shrink,
+            quant=quant,
+        )
     )
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
@@ -429,12 +461,12 @@ def compile_verify_program(
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = verify_fn.lower(
-        pool, logits, i32(slots, pmax), i32(slots),
+        model, pool, logits, i32(slots, pmax), i32(slots),
         np_.zeros((slots,), bool), i32(slots), i32(slots), i32(slots),
         i32(slots, spec_len), i32(slots),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
-    return hlo, mesh, donated_leaves, model_cfg.block_size
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
 
 
 def audit_verify_program(
@@ -444,26 +476,21 @@ def audit_verify_program(
     spec_len: int = 4,
     page_size: int = 16,
     shrink: bool = True,
+    quant: bool = False,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call audit of the speculative verify program: donation-intact,
-    no-host-sync, no-f64 — the CI serving-audit job runs this next to
+    no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
+    — the CI serving-audit job runs this next to
     :func:`audit_decode_window` and :func:`audit_prefill_chunk` so all
     three serving hot-path programs are gated on one geometry."""
-    from midgpt_tpu.analysis.rules import (
-        DonationIntact,
-        NoF64,
-        NoHostSync,
-        RuleSet,
-    )
-
     cfg = (
         get_config(name_or_cfg)
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block = compile_verify_program(
+    hlo, mesh, donated, block, wshapes = compile_verify_program(
         cfg, slots=slots, spec_len=spec_len, page_size=page_size,
-        shrink=shrink,
+        shrink=shrink, quant=quant,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -472,9 +499,7 @@ def audit_verify_program(
         block=block,
         donated_leaves=donated,
     )
-    report = RuleSet([NoF64(), DonationIntact(), NoHostSync()]).evaluate(
-        analysis
-    )
+    report = _serving_rules(wshapes).evaluate(analysis)
     return analysis, report
 
 

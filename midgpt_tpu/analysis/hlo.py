@@ -267,6 +267,23 @@ def parse_input_output_alias(hlo: str) -> tp.List[AliasEntry]:
     return []
 
 
+def parse_entry_parameters(
+    hlo: str,
+) -> tp.Tuple[tp.Tuple[str, ShapeT], ...]:
+    """(dtype, shape) of every flat entry parameter, from the module's
+    ``entry_computation_layout={(...)->...}`` header clause — what the
+    program actually streams in from HBM each launch. The
+    no-dequant-materialization rule checks quantized weights enter as
+    s8 here (and that no full-precision copy does)."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo)
+    if not m:
+        return ()
+    return tuple(
+        (d, tuple(int(x) for x in dims.split(",") if x != ""))
+        for d, dims in _SHAPE_RE.findall(m.group(1))
+    )
+
+
 def count_entry_parameters(hlo: str) -> int:
     """Number of flat parameters of the entry computation, from the
     ``entry_computation_layout={(...)->...}`` header clause."""

@@ -338,6 +338,88 @@ class NoHostSync(Rule):
         return out
 
 
+class NoDequantMaterialization(Rule):
+    """Quantized serving programs (midgpt_tpu.quant) must stream their
+    weights as int8 and keep the dequantization fused into each matmul's
+    epilogue — the whole point of the int8 path is halving the per-token
+    weight HBM stream, and one stray ``dequantize_model`` (or a scale
+    applied to the WEIGHT instead of the matmul result) silently restores
+    the full-precision stream while the engine still reports quant=on.
+
+    Checked against the compiled HLO, parameterized by the quantized
+    weight-matrix shapes (stacked ``[L, in, out]`` leaves and their
+    static per-layer slices, ``midgpt_tpu.quant.quant_weight_shapes``):
+
+    - at least one s8 weight-shaped ENTRY PARAMETER exists (the int8
+      array is what crosses the HBM->program boundary);
+    - no f32/bf16/f16 entry parameter or constant has a weight-matrix
+      shape (nobody smuggled a dequantized copy in);
+    - no ``multiply`` instruction produces an f32/bf16/f16 result of a
+      weight-matrix shape — the scale must land on the ACTIVATION-shaped
+      matmul result (the epilogue), never on the weights (which would
+      materialize the dequantized matrix per use).
+
+    A transient weight-shaped ``convert`` is deliberately NOT flagged:
+    inside a fusion it is exactly the fused dequant this rule demands
+    (TPU fuses the s8->bf16 read into the dot; the CPU test backend
+    materializes it in a loop fusion as an artifact of its Eigen dot
+    lowering — a backend decision the program can't control)."""
+
+    name = "no-dequant-materialization"
+    description = "int8 weights stream quantized; dequant stays fused"
+
+    _MAT = re.compile(
+        r"=\s*(f32|bf16|f16)\[([0-9,]*)\](?:\{[^}]*\})?\s+"
+        r"(multiply|constant)\("
+    )
+
+    def __init__(self, weight_shapes: tp.Iterable[tp.Tuple[int, ...]]):
+        self.weight_shapes = frozenset(
+            tuple(int(d) for d in s) for s in weight_shapes
+        )
+        assert self.weight_shapes, "need the quantized weight shapes"
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        out = []
+        params = hlo_mod.parse_entry_parameters(a.hlo)
+        if not any(
+            d == "s8" and s in self.weight_shapes for d, s in params
+        ):
+            out.append(self.violation(
+                "no s8 weight-shaped entry parameter — the compiled "
+                "program is not consuming the quantized pytree (weights "
+                "dequantized before compilation?)"
+            ))
+        for d, s in params:
+            if d in ("f32", "bf16", "f16") and s in self.weight_shapes:
+                out.append(self.violation(
+                    f"full-precision weight-matrix entry parameter "
+                    f"{d}{list(s)} — a dequantized copy streams from HBM"
+                ))
+        for line in a.hlo.splitlines():
+            m = self._MAT.search(line)
+            if not m:
+                continue
+            shape = tuple(
+                int(x) for x in m.group(2).split(",") if x != ""
+            )
+            if shape not in self.weight_shapes:
+                continue
+            kind = m.group(3)
+            msg = (
+                "scale applied at weight shape (dequantized weight "
+                "materialized) — the epilogue multiply must be "
+                "activation-shaped"
+                if kind == "multiply"
+                else "full-precision weight-matrix constant baked into "
+                "the program"
+            )
+            out.append(self.violation(
+                f"{msg}: {m.group(1)}{list(shape)}", line.strip()
+            ))
+        return out
+
+
 class DonationIntact(Rule):
     """``donate_argnums`` actually stuck: the executable aliases at least
     ``donated_leaves`` parameter buffers to outputs. XLA silently drops

@@ -110,6 +110,19 @@ class Checkpointer:
         items = {k: restored[k] for k in templates}
         return items, dict(restored["meta"])
 
+    def has_item(self, name: str, step: tp.Optional[int] = None) -> bool:
+        """Whether the checkpoint at ``step`` (default latest) stores an
+        item called ``name`` — how loaders pick between the training
+        ``params`` tree and a pre-quantized ``params_q8`` serving tree
+        (midgpt_tpu.quant) without reading any array data."""
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            return False
+        # items are step-directory children (works for local and gs://
+        # paths via epath); item_metadata can't resolve items a fresh
+        # manager has no registered handler for
+        return (self._mngr.directory / str(step) / name).exists()
+
     def item_metadata(self, step: tp.Optional[int] = None) -> tp.Any:
         """Shape/dtype metadata of the stored items WITHOUT reading array
         data — lets callers adapt a config to what a checkpoint actually

@@ -1307,12 +1307,32 @@ class GPT:
 
     def head_weight(self, dtype) -> Array:
         """[D, V] lm-head weight in ``dtype`` (the shared wte array when
-        init-only-tied/tied, SURVEY.md 2.3)."""
+        init-only-tied/tied, SURVEY.md 2.3). Full-precision heads only —
+        a quantized head has no standalone weight to hand out (the scale
+        belongs in the matmul epilogue); use :meth:`project`."""
+        assert not hasattr(self.lm_head, "scale"), (
+            "quantized head: use GPT.project — materializing "
+            "head_weight would dequantize the full [D, V] matrix"
+        )
         return (
             self.wte.weight.T.astype(dtype)
             if self.lm_head is None
             else self.lm_head.weight.astype(dtype)
         )
+
+    def project(self, h: Array) -> Array:
+        """Hidden states ``[..., D]`` -> vocab logits ``[..., V]`` — the
+        ONE lm-head entry point every forward/decode/prefill/verify path
+        uses. For a quantized model (midgpt_tpu.quant) this fuses the
+        dequant epilogue ``(h @ w_int8) * scale`` so the int8 head is
+        what streams from HBM; full-precision models keep the plain
+        ``h @ head_weight`` contraction (bit-identical to the
+        pre-quantization code path)."""
+        from midgpt_tpu.quant import QuantLinear
+
+        if isinstance(self.lm_head, QuantLinear):
+            return self.lm_head(h)
+        return h @ self.head_weight(h.dtype)
 
     def __call__(
         self,
@@ -1325,7 +1345,7 @@ class GPT:
         h = self.hidden(
             tokens, key=key, deterministic=deterministic, attn_impl=attn_impl
         )
-        logits = h @ self.head_weight(h.dtype)  # [B, T, V]
+        logits = self.project(h)  # [B, T, V]
         return shard_act(logits, "batch", "seq", "vocab")
 
 
@@ -1399,7 +1419,7 @@ def decode_step(
         block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
         h, ck, cv = block.decode_at(h, ck, cv, i, slot, mask, sin_h, cos_h)
     h = model.ln_f(h)
-    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
+    logits = model.project(h)[:, 0, :]  # [B, V]
     return logits, KVCache(k=ck, v=cv)
 
 
@@ -1453,7 +1473,7 @@ def decode_step_recent(
             sin_h, cos_h,
         )
     h = model.ln_f(h)
-    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [B, V]
+    logits = model.project(h)[:, 0, :]  # [B, V]
     return logits, rk, rv
 
 
@@ -1512,7 +1532,7 @@ def decode_step_paged(
             sin_h, cos_h,
         )
     h = model.ln_f(h)
-    logits = (h @ model.head_weight(h.dtype))[:, 0, :]  # [S, V]
+    logits = model.project(h)[:, 0, :]  # [S, V]
     return logits, rk, rv
 
 
@@ -1647,7 +1667,7 @@ def verify_tokens_paged(
         ks.append(k)
         vs.append(v)
     h = model.ln_f(h)
-    logits = h @ model.head_weight(h.dtype)  # [S, T, V]
+    logits = model.project(h)  # [S, T, V]
     return logits, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, S, Hkv, T, C]
 
 
@@ -1707,7 +1727,7 @@ def prefill(
     cache_v = jax.lax.dynamic_update_slice_in_dim(
         cache.v, vs.astype(cache.v.dtype), 0, axis=4
     )
-    logits = (h[:, -1, :] @ model.head_weight(h.dtype))  # [B, V]
+    logits = model.project(h[:, -1, :])  # [B, V]
     return logits, KVCache(k=cache_k, v=cache_v)
 
 

@@ -1,0 +1,275 @@
+"""Int8 quantized SERVING weight path: per-output-channel-scaled int8
+parameter pytree with the dequantization fused into each matmul.
+
+PERF.md's decode roofline accounting (r5) puts 124M B=8 serving at
+0.905 ms/tok against a ~0.43 ms HBM floor, and the bf16 weight stream
+(~0.31 ms/step of that floor) is the single largest term: every decode
+step re-reads every parameter. Halving the weight bytes moves the floor
+itself (~0.43 -> ~0.27 ms/step), which no dispatch/kernel optimization
+can — so the quantized pytree is a SERVING artifact: converted from any
+training checkpoint (``quantize_model`` / ``scripts/quantize_ckpt.py``),
+never trained, and consumed by the same model code the bf16 engine runs.
+
+Design rules (the Liger-Kernel fuse-small-ops discipline, PAPERS.md):
+
+- **The int8 array is what streams from HBM.** A :class:`QuantLinear`
+  leaf holds ``weight`` (int8, stored ``[..., in, out]`` like
+  :class:`~midgpt_tpu.models.layers.Linear`) and ``scale`` (f32, one per
+  OUTPUT channel). The forward is ``(x @ w_int8) * scale`` — the
+  dequant lives in the matmul epilogue at ACTIVATION shape. Nothing may
+  materialize a full-precision weight-matrix buffer (audited:
+  ``no-dequant-materialization`` in midgpt_tpu.analysis, CI-gated).
+- **Exactness-preserving scales by default** (``mode="po2"``): scales
+  are powers of two, so ``q * scale`` is exact in f32 AND bf16 (|q| <=
+  127 fits both mantissas; a po2 shift never rounds), and the epilogue
+  form ``(x @ q) * scale`` is BITWISE equal to ``x @ (q * scale)`` —
+  scaling every addend of a float sum by 2^k shifts exponents uniformly
+  and changes no rounding decision. Consequence (tested, not assumed):
+  the quantized engine is greedy token-identical to the bf16 engine
+  running ``dequantize_model(qmodel)``, across the whole serving
+  exactness matrix (prefix cache x chunked prefill x speculation x
+  eviction). The identity-scale special case (``mode="identity"``,
+  scale == 1 over already-integer weights) is the same contract with
+  the shift k = 0. Po2 rounding costs at most one bit of SNR vs
+  fractional absmax scales (``mode="absmax"``, no bitwise contract) —
+  int8 per-channel has headroom for it, and a quantization whose
+  correctness is bit-testable is worth a bit.
+- **Per-channel, output axis.** Scales index the matmul's OUT dim
+  (axis -1 of the stored weight), one scale vector per stacked layer
+  (``[L, out]`` on scan-stacked block leaves, ``[out]`` unstacked), so
+  the epilogue is a row-broadcast multiply the compiler folds into the
+  matmul consumer.
+
+What quantizes: every dense matmul on the serving hot path — attention
+``wqkv``/``wo``, MLP ``w_up``/``w_gate``/``w_down``, and the LM head
+(materialized as a quantized head even for tied/init-tied embeddings:
+``GPT.project`` is the one head entry point and fuses the epilogue).
+What stays full-precision: the token embedding (a gather, not a
+matmul), the tiny QK-norm / RMSNorm scales, and MoE expert stacks
+(``mlp="moe"`` is a training configuration; the serving configs are
+dense — quantize_model asserts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from midgpt_tpu.models.gpt import GPT, Block
+from midgpt_tpu.models.layers import Linear
+from midgpt_tpu.pytree import module
+
+Array = jax.Array
+
+QUANT_MODES = ("po2", "absmax", "identity")
+
+
+@module
+class QuantLinear:
+    """Bias-free linear over an int8 weight with per-output-channel f32
+    scales; the dequant is fused into the matmul epilogue. Drop-in for
+    :class:`~midgpt_tpu.models.layers.Linear` everywhere the model only
+    CALLS the projection (all decode/prefill/verify paths); leaves are
+    layer-stackable exactly like Linear's (``weight [L, in, out]``,
+    ``scale [L, out]`` — a static layer slice ``tree.map(a[i])`` yields
+    the per-layer ``[in, out]`` / ``[out]`` pair)."""
+
+    weight: Array  # int8 [..., in, out] — the HBM-resident stream
+    scale: Array  # f32 [..., out] — per-output-channel dequant scale
+
+    def __call__(self, x: Array) -> Array:  # [..., in] -> [..., out]
+        with jax.named_scope("quant_linear"):
+            # the convert feeds the dot directly (no materialized
+            # full-precision weight; audited) and the scale lands on the
+            # ACTIVATION-shaped result — with po2 scales this is bitwise
+            # x @ dequant(w)
+            y = x @ self.weight.astype(x.dtype)
+            return y * self.scale.astype(y.dtype)
+
+
+def _po2_ceil(x: Array) -> Array:
+    """Smallest power of two >= x (elementwise, x > 0)."""
+    return jnp.exp2(jnp.ceil(jnp.log2(x)))
+
+
+def quantize_per_channel(
+    w: Array, *, mode: str = "po2"
+) -> tp.Tuple[Array, Array]:
+    """Quantize ``w [..., in, out]`` to int8 with one scale per OUTPUT
+    channel (reduced over the ``in`` axis only — stacked leading axes
+    each get their own scale rows). Returns ``(q int8, scale f32)`` with
+    ``dequantize(q, scale) ~= w``; the elementwise error is bounded by
+    ``scale / 2``.
+
+    Modes: ``"po2"`` (default) rounds the absmax/127 scale UP to a power
+    of two — exact ``q * scale`` products and a bitwise epilogue
+    contract (module docstring) for <= 1 bit of extra grid coarseness;
+    ``"absmax"`` keeps the fractional scale (tightest grid, no bitwise
+    contract); ``"identity"`` pins scale = 1 (weights must already be
+    integer-valued in [-127, 127] to round-trip exactly). All-zero
+    channels quantize to zeros with scale 1 (nothing to scale; avoids a
+    0-divide), constant channels land on +-127 (po2: the nearest po2
+    grid point) exactly."""
+    assert mode in QUANT_MODES, f"mode {mode!r} not in {QUANT_MODES}"
+    w32 = jnp.asarray(w, jnp.float32)
+    assert w32.ndim >= 2, f"need [..., in, out], got {w32.shape}"
+    if mode == "identity":
+        scale = jnp.ones(w32.shape[:-2] + w32.shape[-1:], jnp.float32)
+    else:
+        absmax = jnp.max(jnp.abs(w32), axis=-2)  # [..., out]
+        scale = jnp.where(absmax > 0.0, absmax / 127.0, 1.0)
+        if mode == "po2":
+            scale = jnp.where(absmax > 0.0, _po2_ceil(scale), 1.0)
+    q = jnp.clip(
+        jnp.round(w32 / scale[..., None, :]), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q: Array, scale: Array) -> Array:
+    """``q int8 [..., in, out]`` x ``scale [..., out]`` -> f32 weights —
+    the reference the quantized matmul is tested against. Exact for
+    po2/identity scales (an int8 code times a power of two never
+    rounds; this is what the bitwise epilogue contract rests on); with
+    fractional ``absmax`` scales each product carries one ordinary f32
+    rounding (up to ~31 significant bits into 24), so no bitwise
+    contract holds there."""
+    return q.astype(jnp.float32) * scale[..., None, :]
+
+
+def quantize_linear(lin: Linear, *, mode: str = "po2") -> QuantLinear:
+    q, scale = quantize_per_channel(lin.weight, mode=mode)
+    return QuantLinear(weight=q, scale=scale)
+
+
+def dequantize_linear(qlin: QuantLinear) -> Linear:
+    return Linear(weight=dequantize(qlin.weight, qlin.scale))
+
+
+def is_quantized(model: GPT) -> bool:
+    return isinstance(model.lm_head, QuantLinear)
+
+
+def quantize_model(model: GPT, *, mode: str = "po2") -> GPT:
+    """Convert a (trained) GPT into its int8 serving form: every dense
+    matmul weight becomes a :class:`QuantLinear`; the LM head is always
+    materialized quantized (from ``wte.weight.T`` when tied/init-tied —
+    the embedding GATHER keeps the full-precision table, but the head
+    MATMUL streams int8). The result is the same GPT pytree class with
+    the same static config: every decode/prefill/verify program accepts
+    either form through one code path (``GPT.project`` + the block
+    methods calling the projections)."""
+    assert not is_quantized(model), "model is already quantized"
+    cfg = model.config
+    assert cfg.mlp != "moe", (
+        "int8 serving quantization covers the dense configs; the MoE "
+        "expert stacks are raw arrays, not Linear leaves (ROADMAP serving "
+        "configs are dense)"
+    )
+    blocks: Block = model.blocks
+    qlin = lambda lin: quantize_linear(lin, mode=mode)  # noqa: E731
+    attn = dataclasses.replace(
+        blocks.attn, wqkv=qlin(blocks.attn.wqkv), wo=qlin(blocks.attn.wo)
+    )
+    mlp = dataclasses.replace(
+        blocks.mlp,
+        w_up=qlin(blocks.mlp.w_up),
+        w_down=qlin(blocks.mlp.w_down),
+        w_gate=(
+            qlin(blocks.mlp.w_gate) if blocks.mlp.w_gate is not None else None
+        ),
+    )
+    head = (
+        model.lm_head
+        if model.lm_head is not None
+        else Linear(weight=model.wte.weight.T)
+    )
+    return dataclasses.replace(
+        model,
+        blocks=dataclasses.replace(blocks, attn=attn, mlp=mlp),
+        lm_head=qlin(head),
+    )
+
+
+def dequantize_model(qmodel: GPT) -> GPT:
+    """The full-precision model the quantized one encodes: every
+    QuantLinear becomes a plain Linear holding ``dequantize(w, scale)``
+    (exact in f32). With po2 scales the bf16/f32 engine running THIS
+    model is greedy token-identical to the quantized engine running
+    ``qmodel`` — the testable statement of the exactness contract."""
+    assert is_quantized(qmodel), "model is not quantized"
+    blocks: Block = qmodel.blocks
+    dq = dequantize_linear
+    attn = dataclasses.replace(
+        blocks.attn, wqkv=dq(blocks.attn.wqkv), wo=dq(blocks.attn.wo)
+    )
+    mlp = dataclasses.replace(
+        blocks.mlp,
+        w_up=dq(blocks.mlp.w_up),
+        w_down=dq(blocks.mlp.w_down),
+        w_gate=(
+            dq(blocks.mlp.w_gate) if blocks.mlp.w_gate is not None else None
+        ),
+    )
+    return dataclasses.replace(
+        qmodel,
+        blocks=dataclasses.replace(blocks, attn=attn, mlp=mlp),
+        lm_head=dq(qmodel.lm_head),
+    )
+
+
+def quant_weight_shapes(model: GPT) -> tp.FrozenSet[tp.Tuple[int, ...]]:
+    """Every shape a dequantized weight-matrix buffer could take in a
+    compiled program: the stacked ``[L, in, out]`` leaves AND their
+    static per-layer ``[in, out]`` slices (the serving programs' layer
+    loops slice statically). The ``no-dequant-materialization`` audit
+    flags any full-precision buffer/multiply at one of these shapes."""
+    shapes: tp.Set[tp.Tuple[int, ...]] = set()
+
+    def _collect(leaf):
+        if isinstance(leaf, QuantLinear):
+            s = tuple(int(d) for d in leaf.weight.shape)
+            shapes.add(s)
+            if len(s) > 2:
+                shapes.add(s[1:])  # the static layer slice
+
+    for lin in (
+        model.blocks.attn.wqkv,
+        model.blocks.attn.wo,
+        model.blocks.mlp.w_up,
+        model.blocks.mlp.w_down,
+        model.blocks.mlp.w_gate,
+        model.lm_head,
+    ):
+        if lin is not None:
+            _collect(lin)
+    return frozenset(shapes)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint conversion (scripts/quantize_ckpt.py is the CLI front end)
+# ---------------------------------------------------------------------------
+
+QUANT_ITEM = "params_q8"  # the checkpoint item name of a quantized pytree
+
+
+def abstract_quantized(model_cfg) -> GPT:
+    """Shape/dtype template of the quantized pytree for ``model_cfg`` —
+    what :meth:`Checkpointer.restore` needs to land a ``params_q8`` item
+    without materializing a full-precision model first."""
+    return jax.eval_shape(
+        lambda: quantize_model(GPT.init(jax.random.PRNGKey(0), model_cfg))
+    )
+
+
+def restore_quantized(ckpt, model_cfg, step: tp.Optional[int] = None) -> GPT:
+    """Restore a pre-quantized ``params_q8`` item from a checkpoint
+    written by ``scripts/quantize_ckpt.py`` (params-only, no optimizer
+    state, int8 weights land directly — no f32 staging)."""
+    items, _ = ckpt.restore(
+        {QUANT_ITEM: abstract_quantized(model_cfg)}, step=step
+    )
+    return items[QUANT_ITEM]

@@ -91,6 +91,7 @@ def generate_served(
     prefill_chunk: tp.Optional[int] = None,
     prefill_budget: tp.Optional[int] = None,
     speculate: int = 0,
+    quant: tp.Optional[str] = None,
     mesh=None,
 ) -> tp.List[np.ndarray]:
     """One-shot batch generation routed through the serving engine: submit
@@ -99,7 +100,11 @@ def generate_served(
     same greedy tokens, 1/K the decode dispatches, and per-request early
     exit at ``eos_id``. ``speculate=N`` (greedy only) turns decode
     dispatches into n-gram-drafted verify dispatches emitting
-    ``1 + accepted`` tokens each — same tokens, fewer launches."""
+    ``1 + accepted`` tokens each — same tokens, fewer launches.
+    ``quant="int8"`` serves the int8 per-channel quantized weight path
+    (midgpt_tpu.quant: dequant fused into each matmul — halves the
+    per-token weight stream; po2 scales keep greedy output token-
+    identical to the engine running the dequantized weights)."""
     import jax.numpy as jnp
 
     eng = ServingEngine(
@@ -115,6 +120,7 @@ def generate_served(
         prefill_chunk=prefill_chunk,
         prefill_budget=prefill_budget,
         speculate=speculate,
+        quant=quant,
         mesh=mesh,
     )
     rids = [

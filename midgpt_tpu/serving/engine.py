@@ -40,6 +40,14 @@ PERF.md r5) once per generated token. This engine replaces both:
   so the single-writer / refcount / prefix-index invariants are
   untouched. Greedy outputs are token-identical to the non-speculative
   engine — speculation changes the dispatch count, not the stream.
+- **Int8 quantized weight path** (``quant="int8"``, midgpt_tpu.quant):
+  every program the engine compiles streams int8 per-output-channel
+  weights with the dequantization fused into each matmul's epilogue —
+  halving the per-token weight HBM stream that dominates the decode
+  floor. Po2 scales keep greedy output token-identical to the engine
+  running the dequantized weights; the programs take the model as an
+  ENTRY PARAMETER (closed over, jax would bake the weights in as
+  constants — and constant-fold the quantized dequant back to f32).
 - **Fused multi-token dispatch** (the PR 2 design, ported to decode): one
   jitted, state-donating ``lax.scan`` runs K whole-model decode steps —
   all layers, sampling, and the bulk page flush — per XLA launch.
@@ -90,6 +98,23 @@ Array = jax.Array
 # Compiled programs
 # ---------------------------------------------------------------------------
 
+# Program cache: since the model is an ENTRY PARAMETER (not a closure
+# constant — see window_fn), a program factory's output depends only on
+# the model CONFIG and the scalar geometry, so identical geometries
+# share one jitted callable — and therefore one XLA compilation per
+# model structure/dtype (jax.jit caches per wrapper; a fresh wrapper
+# per ServingEngine would recompile the same program every time an
+# engine is constructed, which the test suite does dozens of times).
+_PROGRAM_CACHE: tp.Dict[tp.Tuple, tp.Any] = {}
+
+
+def _cached_program(key: tp.Tuple, build: tp.Callable[[], tp.Any]):
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = build()
+        _PROGRAM_CACHE[key] = fn
+    return fn
+
 
 def make_decode_window(
     model: GPT,
@@ -102,6 +127,32 @@ def make_decode_window(
     temperature: float = 0.0,
     top_k: tp.Optional[int] = None,
     mesh=None,
+):
+    key = (
+        "decode_window", model.config, slots, window, pmax, rope_len,
+        pad_id, temperature, top_k, mesh,
+    )
+    return _cached_program(
+        key,
+        lambda: _build_decode_window(
+            model.config, slots=slots, window=window, pmax=pmax,
+            rope_len=rope_len, pad_id=pad_id, temperature=temperature,
+            top_k=top_k, mesh=mesh,
+        ),
+    )
+
+
+def _build_decode_window(
+    cfg,
+    *,
+    slots: int,
+    window: int,
+    pmax: int,
+    rope_len: int,
+    pad_id: int,
+    temperature: float,
+    top_k: tp.Optional[int],
+    mesh,
 ):
     """The fused K-step decode program: ONE jitted, pool/logits-donating
     ``lax.scan`` over ``window`` whole-model decode steps.
@@ -123,10 +174,15 @@ def make_decode_window(
     from midgpt_tpu.parallel.sharding import axis_rules
     from midgpt_tpu.sampling import sample_token
 
-    cfg = model.config
     rshape = (cfg.n_layer, slots, cfg.kv_heads, window, cfg.head_dim)
 
     def window_fn(
+        model: GPT,  # ENTRY PARAMETER, not a closure constant: closed
+        # over, jax bakes every weight into the executable as an HLO
+        # constant — and for a quantized model XLA then CONSTANT-FOLDS
+        # the dequant (convert + scale) into full f32 weight matrices,
+        # silently doubling the weight stream the int8 path exists to
+        # halve (caught by the no-dequant-materialization audit)
         pool: PagedKVPool,  # DONATED
         logits: Array,  # [S, V] f32 — per-slot next-token logits; DONATED
         bt: Array,  # [S, Pmax] int32 block tables
@@ -199,11 +255,26 @@ def make_decode_window(
             new_len = pooled_len + jnp.sum(wvalid.astype(jnp.int32), axis=0)
         return pool, logits, toks, emit, done, new_len, emitted
 
-    return jax.jit(window_fn, donate_argnums=(0, 1))
+    return jax.jit(window_fn, donate_argnums=(1, 2))
 
 
 def make_prefill_chunk_program(
     model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None
+):
+    key = (
+        "prefill_chunk", model.config, chunk_len, pmax, rope_len, mesh,
+    )
+    return _cached_program(
+        key,
+        lambda: _build_prefill_chunk_program(
+            model.config, chunk_len=chunk_len, pmax=pmax,
+            rope_len=rope_len, mesh=mesh,
+        ),
+    )
+
+
+def _build_prefill_chunk_program(
+    cfg, *, chunk_len: int, pmax: int, rope_len: int, mesh
 ):
     """A prefill-chunk program for one padded chunk length: one forward
     over the chunk's tokens attending to the slot's already-resident
@@ -218,10 +289,11 @@ def make_prefill_chunk_program(
     chunking hits a single bucket in steady state."""
     from midgpt_tpu.parallel.sharding import axis_rules
 
-    cfg = model.config
     assert chunk_len <= cfg.block_size, (chunk_len, cfg.block_size)
 
     def chunk_fn(
+        model: GPT,  # entry parameter (same constant-folding trap as
+        # the decode window — see make_decode_window)
         pool: PagedKVPool,  # DONATED
         logits: Array,  # [S, V] DONATED
         slot: Array,  # [] int32 — the prefilling slot
@@ -241,15 +313,13 @@ def make_prefill_chunk_program(
             h_last = jax.lax.dynamic_slice_in_dim(
                 h, real_n - 1, 1, axis=1
             )[:, 0]  # [1, D]
-            row = (h_last @ model.head_weight(h_last.dtype)).astype(
-                logits.dtype
-            )[0]
+            row = model.project(h_last).astype(logits.dtype)[0]
             logits = jax.lax.dynamic_update_slice(
                 logits, row[None], (slot, jnp.zeros((), slot.dtype))
             )
         return pool, logits
 
-    return jax.jit(chunk_fn, donate_argnums=(0, 1))
+    return jax.jit(chunk_fn, donate_argnums=(1, 2))
 
 
 def make_verify_program(
@@ -261,6 +331,29 @@ def make_verify_program(
     rope_len: int,
     pad_id: int = 0,
     mesh=None,
+):
+    key = (
+        "verify", model.config, slots, spec_len, pmax, rope_len, pad_id,
+        mesh,
+    )
+    return _cached_program(
+        key,
+        lambda: _build_verify_program(
+            model.config, slots=slots, spec_len=spec_len, pmax=pmax,
+            rope_len=rope_len, pad_id=pad_id, mesh=mesh,
+        ),
+    )
+
+
+def _build_verify_program(
+    cfg,
+    *,
+    slots: int,
+    spec_len: int,
+    pmax: int,
+    rope_len: int,
+    pad_id: int,
+    mesh,
 ):
     """The speculative-decoding verification program: ONE jitted,
     pool/logits-donating dispatch that scores every slot's
@@ -294,6 +387,8 @@ def make_verify_program(
     t = spec_len + 1
 
     def verify_fn(
+        model: GPT,  # entry parameter (same constant-folding trap as
+        # the decode window — see make_decode_window)
         pool: PagedKVPool,  # DONATED
         logits: Array,  # [S, V] f32 — per-slot next-token logits; DONATED
         bt: Array,  # [S, Pmax] int32 block tables
@@ -367,15 +462,18 @@ def make_verify_program(
             n_acc,
         )
 
-    return jax.jit(verify_fn, donate_argnums=(0, 1))
+    return jax.jit(verify_fn, donate_argnums=(1, 2))
 
 
 def make_copy_page_program():
     """The jitted copy-on-write primitive: duplicate one page so an
     admission landing on a partially-shared cached page gets a private
     copy to append into. Pool donated — the copy is in-place up to the
-    one written page row."""
-    return jax.jit(copy_page, donate_argnums=(0,))
+    one written page row. One shared wrapper (program cache): copy_page
+    is model-free, so every engine reuses the same jit cache."""
+    return _cached_program(
+        ("copy_page",), lambda: jax.jit(copy_page, donate_argnums=(0,))
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -482,10 +580,24 @@ class ServingEngine:
         prefill_budget: tp.Optional[int] = None,
         speculate: int = 0,
         proposer: tp.Optional[Proposer] = None,
+        quant: tp.Optional[str] = None,
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
     ):
         assert slots >= 1 and window >= 1 and page_size >= 1
+        # quantized weight path (midgpt_tpu.quant): quant="int8" converts
+        # the model to the int8 per-channel serving pytree here, so every
+        # program this engine compiles (decode window, prefill chunk,
+        # verify) streams int8 weights with the dequant fused into each
+        # matmul. Passing an already-quantized model with quant=None is
+        # equally valid — the programs accept either form through one
+        # code path (GPT.project + the block projections).
+        assert quant in (None, "int8"), f"unknown quant mode {quant!r}"
+        if quant is not None:
+            from midgpt_tpu.quant import is_quantized, quantize_model
+
+            if not is_quantized(model):
+                model = quantize_model(model)
         cfg = model.config
         # page grid must tile the context: otherwise a near-block prompt
         # padded up to the page grid exceeds block_size and prefill
@@ -810,6 +922,7 @@ class ServingEngine:
                 mesh=self._mesh,
             )
         self.pool, self.logits = self._chunk_fns[bucket](
+            self.model,
             self.pool,
             self.logits,
             jnp.asarray(s, jnp.int32),
@@ -1014,6 +1127,7 @@ class ServingEngine:
             self.pool, self.logits, cand, emit, done_d, new_len,
             emitted_d, n_acc,
         ) = self._verify_fn(
+            self.model,
             self.pool,
             self.logits,
             jnp.asarray(self.bt),
@@ -1077,6 +1191,7 @@ class ServingEngine:
         (
             self.pool, self.logits, toks, emit, done_d, new_len, emitted_d
         ) = self._window_fn(
+            self.model,
             self.pool,
             self.logits,
             jnp.asarray(self.bt),
@@ -1151,6 +1266,7 @@ class ServingEngine:
                     mesh=self._mesh,
                 )
             self.pool, self.logits = self._chunk_fns[b](
+                self.model,
                 self.pool,
                 self.logits,
                 jnp.asarray(0, jnp.int32),

@@ -107,6 +107,14 @@ def main() -> None:
         help="disable prefix-cache page sharing in --serve mode",
     )
     ap.add_argument(
+        "--quant", choices=("int8",), default=None,
+        help="serve the int8 per-channel quantized weight path "
+        "(midgpt_tpu.quant): restores a pre-quantized params_q8 item "
+        "when the checkpoint has one (scripts/quantize_ckpt.py), else "
+        "quantizes the restored bf16 params on the fly; dequant is "
+        "fused into every matmul, halving the per-token weight stream",
+    )
+    ap.add_argument(
         "--eos_id", type=int, default=None,
         help="stop a request early at this token id (--serve mode only)",
     )
@@ -128,13 +136,23 @@ def main() -> None:
     cfg = load_run_config(args.ckpt_dir)
 
     ckpt = Checkpointer(args.ckpt_dir, save_interval_steps=1)
-    # pre-256-rounding checkpoints hold the legacy fractional SwiGLU width —
-    # pin to whatever the checkpoint actually stores (no-op otherwise)
+    from midgpt_tpu.quant import QUANT_ITEM, abstract_quantized
+
+    # pre-quantized serving checkpoint (scripts/quantize_ckpt.py): restore
+    # the params_q8 item — the int8 weights land directly, no f32 staging
+    use_q8 = bool(args.quant) and ckpt.has_item(QUANT_ITEM)
     import dataclasses
 
     from midgpt_tpu.models.gpt import pin_mlp_hidden_from_ckpt
 
-    cfg = dataclasses.replace(cfg, model=pin_mlp_hidden_from_ckpt(cfg.model, ckpt))
+    if not use_q8:
+        # pre-256-rounding checkpoints hold the legacy fractional SwiGLU
+        # width — pin to whatever the checkpoint actually stores (no-op
+        # otherwise). A params_q8 checkpoint has no "params" metadata to
+        # read; quantize_ckpt.py pins the width into its config.json
+        cfg = dataclasses.replace(
+            cfg, model=pin_mlp_hidden_from_ckpt(cfg.model, ckpt)
+        )
 
     # params-only restore: checkpoints store params / opt_state as separate
     # items, so sampling never materializes Adam moments (the reference
@@ -144,10 +162,17 @@ def main() -> None:
 
         return GPT.init(key, cfg.model)
 
-    abstract_params = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    item = QUANT_ITEM if use_q8 else "params"
+    abstract_params = (
+        abstract_quantized(cfg.model)
+        if use_q8
+        else jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    )
 
     # multi-chip: restore straight into the config's mesh shardings and
-    # decode distributed (the reference replicates fully, sample.py:177-182)
+    # decode distributed (the reference replicates fully, sample.py:177-182).
+    # The rules match quantized leaves too (same `.../weight` paths; the
+    # tiny per-channel scale vectors stay replicated)
     mesh = None
     if jax.device_count() > 1:
         from midgpt_tpu.models.gpt import GPT_PARAM_RULES
@@ -166,9 +191,13 @@ def main() -> None:
                 shardings,
             )
 
-    items, meta = ckpt.restore({"params": abstract_params})
-    print(f"restored step {meta['step']} from {args.ckpt_dir}")
-    model = items["params"]
+    items, meta = ckpt.restore({item: abstract_params})
+    model = items[item]
+    print(
+        f"restored step {meta['step']}"
+        + (f" (pre-quantized {QUANT_ITEM})" if use_q8 else "")
+        + f" from {args.ckpt_dir}"
+    )
 
     encode, decode = get_tokenizer(cfg.data_dir)
     start = args.start
@@ -179,6 +208,11 @@ def main() -> None:
     prompt = np.tile(prompt[None, :], (args.num_samples, 1))
 
     model = cast_floating(model, jnp.bfloat16)
+    if args.quant:
+        from midgpt_tpu.quant import is_quantized, quantize_model
+
+        if not is_quantized(model):
+            model = quantize_model(model)  # on-the-fly from a bf16 ckpt
     if args.serve:
         from midgpt_tpu.serving import generate_served
 

@@ -15,6 +15,14 @@ clock, and emits ONE JSON record:
   serve_cow_copies       copy-on-write page duplications
   serve_spec_acceptance_rate  drafted tokens the model's argmax accepted
   serve_verify_dispatches     speculative verify dispatches
+  serve_quant            int8 quantized weight path on/off
+  serve_peak_hbm_bytes   device peak HBM after the trace (null on CPU)
+
+The quantized weight path (--quant on) converts the model to the int8
+per-channel pytree (midgpt_tpu.quant) before the engine compiles its
+programs: the weight stream every decode step pays halves (bf16 -> int8
+bytes), which PERF.md r5's roofline puts at ~0.31 ms of the 0.43 ms
+124M B=8 floor — run --quant off/on on the same trace to ladder it.
 
 Self-speculative decoding (--spec on, greedy only): every decode
 dispatch drafts up to --spec_len tokens per request by n-gram lookup
@@ -87,6 +95,12 @@ def main() -> None:
     ap.add_argument("--repetitive", action="store_true",
                     help="tile each prompt from a short random pattern — "
                     "the self-repeating workload n-gram drafting targets")
+    ap.add_argument("--quant", choices=("on", "off"), default="off",
+                    help="serve the int8 per-channel quantized weight "
+                    "path (midgpt_tpu.quant): dequant fused into each "
+                    "matmul, halving the per-token weight HBM stream — "
+                    "visible as both serve_tok_s (latency) and "
+                    "serve_peak_hbm_bytes (memory)")
     ap.add_argument("--out", default=None,
                     help="output JSON path (default "
                     "artifacts/bench_serving.json; the r6 queue's K-ladder "
@@ -125,6 +139,14 @@ def main() -> None:
         "request mix must fit block_size"
     )
     model = cast_floating(GPT.init(jax.random.PRNGKey(0), cfg), jnp.bfloat16)
+    if args.quant == "on":
+        # quantize HERE and rebind so the bf16 weights are actually
+        # dropped — quantizing inside the engine would leave this
+        # binding alive and serve_peak_hbm_bytes would report bf16 +
+        # int8 resident, hiding the residency win the flag measures
+        from midgpt_tpu.quant import quantize_model
+
+        model = quantize_model(model)
 
     rng = np.random.default_rng(args.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
@@ -206,6 +228,12 @@ def main() -> None:
             )
     wall = time.monotonic() - t0
 
+    # device peak HBM AFTER the trace: the halved weight stream is a
+    # residency win too (int8 params + the same KV pool). CPU backends
+    # report no memory_stats — emit null rather than a fake number.
+    mem = jax.devices()[0].memory_stats() or {}
+    peak_hbm = mem.get("peak_bytes_in_use")
+
     ttfts = sorted(
         (r.first_token_time - r.submit_time) * 1e3
         for r in eng.finished.values()
@@ -221,7 +249,10 @@ def main() -> None:
             f"sys={args.sys_prompt_len} "
             f"spec={args.spec_len if args.spec == 'on' else 'off'}"
             f"{' rep' if args.repetitive else ''}"
+            f" quant={args.quant}"
         ),
+        "serve_quant": args.quant,
+        "serve_peak_hbm_bytes": peak_hbm,
         "serve_requests": args.requests,
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
         "serve_wall_s": round(wall, 3),

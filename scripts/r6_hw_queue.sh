@@ -88,6 +88,14 @@ run serving_spec_off python scripts/bench_serving.py --platform=tpu \
 run serving_spec_on python scripts/bench_serving.py --platform=tpu \
   --repetitive --spec on --spec_len 8 \
   --out artifacts/bench_serving_spec_on.json
+# Int8 quantized weight path (PR 6): identical trace with the bf16 vs
+# int8 weight stream — serve_tok_s measures the halved-weight-stream
+# floor move (~0.43 -> ~0.27 ms/step at 124M B=8 per PERF.md's roofline
+# arithmetic; target measured ms/tok toward ~0.6), and
+# serve_peak_hbm_bytes shows the residency win. The bf16 rung reuses
+# artifacts/bench_serving.json (the default-run rung above).
+run serving_quant python scripts/bench_serving.py --platform=tpu \
+  --quant on --out artifacts/bench_serving_quant.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -1,0 +1,461 @@
+"""Int8 quantized serving weight path (midgpt_tpu.quant): per-channel
+quantize/dequantize round-trip bounds and scale-shape units, the po2
+bitwise epilogue contract at the layer and whole-engine level (quant
+engine greedy token-identical to the bf16/f32 engine running the
+dequantized weights, across the serving exactness matrix), real int8
+accuracy bounds on a trained fixture checkpoint, checkpoint conversion
+round-trip, and the no-dequant-materialization audit."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from midgpt_tpu.config import ModelConfig
+from midgpt_tpu.models.gpt import GPT
+from midgpt_tpu.models.layers import Linear
+from midgpt_tpu.pytree import cast_floating
+from midgpt_tpu.quant import (
+    QuantLinear,
+    dequantize,
+    dequantize_model,
+    is_quantized,
+    quant_weight_shapes,
+    quantize_model,
+    quantize_per_channel,
+)
+from midgpt_tpu.serving import ServingEngine, generate_served
+
+CFG = ModelConfig(
+    block_size=64, vocab_size=96, n_layer=2, n_head=4, n_embd=32,
+    dropout=0.0, attn_impl="naive", remat="none",
+)
+
+
+def _model(seed=0):
+    return GPT.init(jax.random.PRNGKey(seed), CFG)
+
+
+def _prompts(n, base_len=5, stride=3):
+    return [
+        np.asarray(
+            jax.random.randint(
+                jax.random.PRNGKey(100 + i), (base_len + stride * i,), 0,
+                CFG.vocab_size,
+            )
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def quant_pair():
+    """(qmodel, dmodel): the quantized model and the full-precision model
+    it encodes — the pair the po2 exactness contract relates."""
+    qm = quantize_model(_model())
+    return qm, dequantize_model(qm)
+
+
+@pytest.fixture(scope="module")
+def trained_case():
+    """The accuracy fixture checkpoint: a tiny GPT trained ~200 Adam
+    steps to memorize a tiled 17-token pattern. Random-init logits are
+    near-tied noise (quantization flips ~2-4% of their argmaxes no
+    matter the model size), which says nothing about serving a real
+    checkpoint; a trained model has the sharp margins real traffic sees,
+    so the >= 99% argmax-agreement bar is meaningful."""
+    rng = np.random.default_rng(0)
+    pat = rng.integers(0, CFG.vocab_size, 17)
+    corpus = np.tile(pat, 200)
+    model = _model()
+    tx = optax.adam(3e-3)
+    opt = tx.init(model)
+
+    def loss_fn(m, x, y):
+        lg = m(x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg, y
+        ).mean()
+
+    @jax.jit
+    def step(m, o, x, y):
+        _, g = jax.value_and_grad(loss_fn)(m, x, y)
+        up, o = tx.update(g, o)
+        return optax.apply_updates(m, up), o
+
+    b, t = 8, CFG.block_size
+    for _ in range(200):
+        starts = rng.integers(0, len(corpus) - t - 1, b)
+        x = jnp.asarray(np.stack([corpus[s : s + t] for s in starts]))
+        y = jnp.asarray(np.stack([corpus[s + 1 : s + t + 1] for s in starts]))
+        model, opt = step(model, opt, x, y)
+    return model, corpus
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize units (model-independent)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_shapes_and_output_axis():
+    """Scales index the OUTPUT channel (last axis), one row per stacked
+    layer; rescaling one output column moves only its own scale."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 16, 24))
+    q, s = quantize_per_channel(w)
+    assert q.shape == w.shape and q.dtype == jnp.int8
+    assert s.shape == (3, 24) and s.dtype == jnp.float32
+    w2 = w.at[:, :, 7].multiply(64.0)
+    _, s2 = quantize_per_channel(w2)
+    changed = np.nonzero(~np.isclose(np.asarray(s), np.asarray(s2)))
+    assert set(changed[1].tolist()) == {7}
+    # unstacked [in, out] works identically
+    q1, s1 = quantize_per_channel(w[0])
+    assert q1.shape == (16, 24) and s1.shape == (24,)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q[0]))
+
+
+def test_roundtrip_error_bound_and_po2_scales():
+    w = jax.random.normal(jax.random.PRNGKey(1), (32, 48))
+    for mode in ("po2", "absmax"):
+        q, s = quantize_per_channel(w, mode=mode)
+        err = jnp.abs(dequantize(q, s) - w)
+        assert bool(jnp.all(err <= s[None, :] / 2 + 1e-7)), mode
+    q, s = quantize_per_channel(w, mode="po2")
+    # po2 scales ARE powers of two (the bitwise-epilogue precondition)
+    assert bool(jnp.all(jnp.exp2(jnp.round(jnp.log2(s))) == s))
+    # ... and still cover the range: no clipping beyond rounding
+    assert bool(jnp.all(s >= jnp.max(jnp.abs(w), axis=0) / 127.0))
+
+
+def test_all_zero_channel():
+    w = jnp.zeros((8, 4)).at[:, 1].set(
+        jax.random.normal(jax.random.PRNGKey(2), (8,))
+    )
+    for mode in ("po2", "absmax"):
+        q, s = quantize_per_channel(w, mode=mode)
+        assert bool(jnp.all(q[:, 0] == 0)) and float(s[0]) == 1.0
+        np.testing.assert_array_equal(
+            np.asarray(dequantize(q, s)[:, 0]), np.zeros(8)
+        )
+
+
+def test_constant_channel():
+    """A constant channel maps to +-127 on the absmax grid (near-exact
+    round-trip) and stays within scale/2 on the po2 grid."""
+    w = jnp.concatenate(
+        [
+            jnp.full((16, 1), -0.73),
+            jax.random.normal(jax.random.PRNGKey(3), (16, 3)),
+        ],
+        axis=1,
+    )
+    q, s = quantize_per_channel(w, mode="absmax")
+    assert bool(jnp.all(q[:, 0] == -127))
+    np.testing.assert_allclose(
+        np.asarray(dequantize(q, s)[:, 0]), -0.73, rtol=1e-6
+    )
+    q, s = quantize_per_channel(w, mode="po2")
+    assert bool(jnp.all(jnp.abs(dequantize(q, s)[:, 0] + 0.73) <= s[0] / 2))
+
+
+def test_identity_mode_exact_on_integer_weights():
+    w = jnp.asarray(
+        jax.random.randint(jax.random.PRNGKey(4), (12, 8), -127, 128),
+        jnp.float32,
+    )
+    q, s = quantize_per_channel(w, mode="identity")
+    assert bool(jnp.all(s == 1.0))
+    np.testing.assert_array_equal(np.asarray(dequantize(q, s)), np.asarray(w))
+    x = jax.random.normal(jax.random.PRNGKey(5), (3, 12))
+    lhs = QuantLinear(weight=q, scale=s)(x)
+    np.testing.assert_array_equal(np.asarray(lhs), np.asarray(x @ w))
+
+
+def test_quant_linear_bitwise_equals_dequant_matmul():
+    """The epilogue contract at layer granularity: (x @ q) * s is
+    BITWISE x @ dequant(q, s) with po2 scales — in f32 and in bf16."""
+    lin = Linear.init(jax.random.PRNGKey(6), 32, 48)
+    q, s = quantize_per_channel(lin.weight)
+    ql = QuantLinear(weight=q, scale=s)
+    dw = dequantize(q, s)
+    for dt in (jnp.float32, jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(7), (4, 32)).astype(dt)
+        lhs = jax.jit(lambda x_: ql(x_))(x_=x)
+        rhs = jax.jit(lambda x_: x_ @ dw.astype(dt))(x_=x)
+        np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+# ---------------------------------------------------------------------------
+# model conversion
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_model_structure(quant_pair):
+    qm, dm = quant_pair
+    assert is_quantized(qm) and not is_quantized(dm)
+    for leaf in (
+        qm.blocks.attn.wqkv, qm.blocks.attn.wo, qm.blocks.mlp.w_up,
+        qm.blocks.mlp.w_down, qm.lm_head,
+    ):
+        assert isinstance(leaf, QuantLinear)
+        assert leaf.weight.dtype == jnp.int8
+    # the embedding GATHER stays full-precision; the head MATMUL streams
+    # int8 even when tied (materialized from wte.T)
+    assert qm.wte.weight.dtype == jnp.float32
+    tied = GPT.init(
+        jax.random.PRNGKey(0), dataclasses.replace(CFG, tie_embeddings=True)
+    )
+    qt = quantize_model(tied)
+    assert isinstance(qt.lm_head, QuantLinear)
+    with pytest.raises(AssertionError):
+        quantize_model(qm)  # already quantized
+    with pytest.raises(AssertionError):
+        qm.head_weight(jnp.float32)  # would materialize the dequant
+
+
+def test_po2_quantize_dequantize_is_a_fixed_point(quant_pair):
+    """quantize(dequantize(Q)) == Q leaf-bitwise for po2 scales: the
+    dequantized model carries exactly the information of the quantized
+    one, so conversion is idempotent — no drift across save/convert
+    cycles."""
+    qm, dm = quant_pair
+    qm2 = quantize_model(dm)
+    for a, b in zip(jax.tree.leaves(qm), jax.tree.leaves(qm2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quant_weight_shapes(quant_pair):
+    qm, _ = quant_pair
+    shapes = quant_weight_shapes(qm)
+    l, d = CFG.n_layer, CFG.n_embd
+    qkv_out = (CFG.n_head + 2 * CFG.kv_heads) * CFG.head_dim
+    assert (l, d, qkv_out) in shapes  # stacked wqkv
+    assert (d, qkv_out) in shapes  # its static per-layer slice
+    assert (d, CFG.vocab_size) in shapes  # lm head
+
+
+# ---------------------------------------------------------------------------
+# engine exactness matrix: quant engine vs the bf16/f32 engine running
+# the dequantized weights (the po2 contract, end to end)
+# ---------------------------------------------------------------------------
+
+
+def test_quant_engine_token_identity_matrix(quant_pair):
+    """Acceptance: the quantized engine's greedy output is token-
+    identical to the full-precision engine running dequantize_model(Q),
+    across prefix-cache on/off x chunked vs monolithic prefill x
+    speculation — mid-run admission included (more requests than
+    slots)."""
+    qm, dm = quant_pair
+    prompts = _prompts(4)
+    lens = [9, 12, 7, 10]
+
+    def run(model, quant, prefix_cache, prefill_chunk, speculate):
+        eng = ServingEngine(
+            model, slots=2, page_size=8, window=4, temperature=0.0,
+            cache_dtype=jnp.float32, prefix_cache=prefix_cache,
+            prefill_chunk=prefill_chunk, speculate=speculate, quant=quant,
+        )
+        rids = [eng.submit(p, n) for p, n in zip(prompts, lens)]
+        fin = eng.run()
+        eng.alloc.check()
+        assert eng.alloc.held_pages == 0
+        return [fin[r].tokens for r in rids]
+
+    base = run(dm, None, True, None, 0)
+    for variant in [(True, None, 0), (False, 8, 0), (True, 8, 4)]:
+        got = run(qm, None, *variant)
+        assert got == base, f"variant {variant} diverged"
+    # the engine-side knob quantizes the given full-precision model to
+    # the same pytree (po2 fixed point) — same streams again
+    assert run(dm, "int8", True, None, 0) == base
+
+
+def test_quant_engine_identity_under_eviction_and_bf16_cache(quant_pair):
+    """Quant x page pressure (evict/re-admit through the prefix cache)
+    and quant x bf16 KV pool: the po2 contract holds in bf16 too, so
+    the streams stay identical in the serving dtype configuration."""
+    qm, dm = quant_pair
+    prompts = _prompts(4, base_len=6, stride=0)
+    n_new = 16
+
+    def run(model, **kw):
+        eng = ServingEngine(
+            model, slots=2, page_size=8, window=4, temperature=0.0,
+            prefix_cache=True, **kw,
+        )
+        rids = [eng.submit(p, n_new) for p in prompts]
+        fin = eng.run()
+        return [fin[r].tokens for r in rids], eng
+
+    base, _ = run(dm, cache_dtype=jnp.float32, num_pages=5)
+    got, eng = run(qm, cache_dtype=jnp.float32, num_pages=5)
+    assert eng.evictions > 0, "trace was sized to force eviction"
+    assert got == base
+    base_bf, _ = run(dm, cache_dtype=jnp.bfloat16)
+    got_bf, _ = run(qm, cache_dtype=jnp.bfloat16)
+    assert got_bf == base_bf
+
+
+def test_generate_served_quant_knob(quant_pair):
+    _, dm = quant_pair
+    prompts = _prompts(2)
+    base = generate_served(
+        dm, prompts, 8, window=4, page_size=8, cache_dtype=jnp.float32
+    )
+    got = generate_served(
+        dm, prompts, 8, window=4, page_size=8, cache_dtype=jnp.float32,
+        quant="int8",
+    )
+    for a, b in zip(base, got):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(AssertionError):
+        ServingEngine(dm, slots=1, quant="int4")
+
+
+# ---------------------------------------------------------------------------
+# real int8 accuracy on the trained fixture checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_real_int8_accuracy_bounds_on_trained_fixture(trained_case):
+    """Acceptance: >= 99% greedy argmax agreement over >= 128 token
+    positions and bounded logit error between the f32 fixture checkpoint
+    and its int8 quantization — teacher-forced on held-out crops of the
+    training corpus (the distribution the checkpoint actually models)."""
+    model, corpus = trained_case
+    qm = quantize_model(model)
+    rng = np.random.default_rng(7)
+    t = CFG.block_size
+    starts = rng.integers(0, len(corpus) - t, 4)
+    toks = jnp.asarray(np.stack([corpus[s : s + t] for s in starts]))
+    lf = jax.jit(lambda m, x: m(x))(model, toks)
+    lq = jax.jit(lambda m, x: m(x))(qm, toks)
+    n_pos = int(toks.size)
+    assert n_pos >= 128
+    agree = float(jnp.mean(jnp.argmax(lq, -1) == jnp.argmax(lf, -1)))
+    assert agree >= 0.99, f"argmax agreement {agree:.4f} over {n_pos} pos"
+    max_err = float(jnp.max(jnp.abs(lq - lf)))
+    rel = max_err / float(jnp.std(lf))
+    assert rel <= 0.25, f"max logit error {max_err:.4f} = {rel:.3f} x std"
+
+
+def test_quant_engine_serves_trained_fixture_greedily(trained_case):
+    """End-to-end: the int8 engine generates >= 128 greedy tokens from
+    the fixture checkpoint with >= 99% agreement against the f32 engine
+    (the engines' streams may legitimately differ where the quantized
+    model IS a different function — this bounds how much)."""
+    model, corpus = trained_case
+    prompt = np.asarray(corpus[:24], np.int32)
+    n_new = 32
+    base = generate_served(
+        model, [prompt] * 4, n_new, window=4, page_size=8,
+        cache_dtype=jnp.float32,
+    )
+    got = generate_served(
+        model, [prompt] * 4, n_new, window=4, page_size=8,
+        cache_dtype=jnp.float32, quant="int8",
+    )
+    total = sum(len(b) for b in base)
+    same = sum(
+        int(x == y) for b, g in zip(base, got) for x, y in zip(b, g)
+    )
+    assert total >= 128
+    assert same / total >= 0.99, f"{same}/{total} tokens agree"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint conversion round trip
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_ckpt_roundtrip(tmp_path, quant_pair):
+    """Checkpointer saves/restores the quantized pytree (int8 leaves and
+    all) via the params_q8 item, and has_item picks the right loader."""
+    from midgpt_tpu.checkpoint import Checkpointer
+    from midgpt_tpu.quant import QUANT_ITEM, restore_quantized
+
+    qm, _ = quant_pair
+    d = str(tmp_path / "run-int8")
+    ck = Checkpointer(d, save_interval_steps=1, async_save=False)
+    ck.save(5, {QUANT_ITEM: qm}, {"step": 5, "quant_mode": "po2"}, force=True)
+    ck.close()
+    ck2 = Checkpointer(d, save_interval_steps=1)
+    assert ck2.has_item(QUANT_ITEM) and not ck2.has_item("params")
+    got = restore_quantized(ck2, CFG)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(qm)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# no-dequant-materialization audit
+# ---------------------------------------------------------------------------
+
+
+def test_no_dequant_materialization_rule_on_fixtures():
+    """Rule semantics on canned HLO (jax-free, like the other rule
+    units): a fused program passes; a dequantized-before-compile
+    program, a smuggled full-precision weight param, a weight-shaped
+    scale multiply, and a baked-in weight constant each fail."""
+    from midgpt_tpu.analysis.hlo import MeshInfo
+    from midgpt_tpu.analysis.rules import (
+        NoDequantMaterialization,
+        StepAnalysis,
+    )
+
+    mesh = MeshInfo(axis_names=("replica",), axis_sizes=(1,))
+    wshapes = {(768, 2304)}
+    rule = NoDequantMaterialization(wshapes)
+
+    def analyze(hlo):
+        return rule.check(StepAnalysis.from_text(hlo, mesh))
+
+    good = """HloModule m, entry_computation_layout={(bf16[4,768]{1,0}, s8[768,2304]{1,0}, f32[2304]{0})->bf16[4,2304]{1,0}}
+ENTRY %main (p0: bf16[4,768], p1: s8[768,2304], p2: f32[2304]) -> bf16[4,2304] {
+  %dot = f32[4,2304]{1,0} dot(f32[4,768]{1,0} %a, f32[768,2304]{1,0} %b)
+  %mul = bf16[4,2304]{1,0} multiply(bf16[4,2304]{1,0} %c, bf16[4,2304]{1,0} %d)
+}
+"""
+    assert analyze(good) == []
+    pre_dequant = good.replace("s8[768,2304]", "bf16[768,2304]")
+    found = analyze(pre_dequant)
+    assert len(found) == 2  # no s8 param AND an f-precision weight param
+    weight_mul = good.replace(
+        "%mul = bf16[4,2304]{1,0} multiply(bf16[4,2304]{1,0} %c, bf16[4,2304]{1,0} %d)",
+        "%mul = f32[768,2304]{1,0} multiply(f32[768,2304]{1,0} %c, f32[768,2304]{1,0} %d)",
+    )
+    assert any("weight shape" in v.message for v in analyze(weight_mul))
+    baked = good + "  %k = f32[768,2304]{1,0} constant({...})\n"
+    assert any("constant" in v.message for v in analyze(baked))
+
+
+@pytest.mark.slow
+def test_quant_serving_audits_pass():
+    """The three QUANTIZED serving programs pass donation-intact +
+    no-host-sync + no-dequant-materialization (the CI serving-audit
+    gate): int8 weights enter as s8 entry parameters and no
+    full-precision weight matrix is streamed, baked in, or
+    materialized by a weight-shaped scale multiply."""
+    from midgpt_tpu.analysis.harness import (
+        audit_decode_window,
+        audit_prefill_chunk,
+        audit_verify_program,
+    )
+    from midgpt_tpu.config import get_config
+
+    cfg = get_config("shakespeare_char")
+    for fn, kw in (
+        (audit_decode_window, dict(slots=2, window=2, page_size=8)),
+        (audit_prefill_chunk, dict(chunk_len=32, page_size=8)),
+        (audit_verify_program, dict(slots=2, spec_len=2, page_size=8)),
+    ):
+        analysis, report = fn(cfg, quant=True, **kw)
+        assert report.ok, report.violations
+        assert any(
+            r.rule == "no-dequant-materialization" for r in report.results
+        )
+        assert len({e.param_number for e in analysis.aliases}) >= 3
