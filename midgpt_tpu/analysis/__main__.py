@@ -114,6 +114,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="draft length for the speculative verify-program audit "
         "(default 4)",
     )
+    p.add_argument(
+        "--mesh-shape", default=None, metavar="SPEC",
+        help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
+        "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
+        "serving programs TP-SHARDED — KV-head-sharded pool, "
+        "column/row-parallel weights, vocab-sharded logits — adding "
+        "the no-batch-allgather-in-page-gather rule; needs --mesh >= "
+        "the axis product. --serving only.",
+    )
     return p
 
 
@@ -196,6 +205,22 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             cfg, steps_per_dispatch=args.steps_per_dispatch
         )
 
+    mesh_shape = None
+    if args.mesh_shape:
+        from midgpt_tpu.analysis.harness import parse_mesh_shape
+
+        if not args.serving:
+            print(
+                "error: --mesh-shape applies to the --serving audits",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            mesh_shape = parse_mesh_shape(args.mesh_shape)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
     if args.serving:
         from midgpt_tpu.analysis.harness import (
             audit_decode_window,
@@ -210,6 +235,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             window=k,
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
+            mesh_shape=mesh_shape,
         )
         # the chunked-prefill steady state interleaves a prefill chunk
         # between decode windows (its block table may alias pages shared
@@ -218,6 +244,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             cfg,
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
+            mesh_shape=mesh_shape,
         )
         # with speculation on every decode dispatch IS a verify dispatch:
         # audit the verify program on the same geometry as the other two
@@ -228,6 +255,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             spec_len=args.serving_spec_len,
             page_size=args.serving_page_size,
             shrink=not args.no_shrink,
+            mesh_shape=mesh_shape,
         )
         # the int8 quantized weight path compiles all three programs
         # again from the SAME _serving_audit_setup geometry and adds the
@@ -253,7 +281,8 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             )),
         ):
             q_analysis, q_report = qfn(
-                cfg, shrink=not args.no_shrink, quant=True, **qkw
+                cfg, shrink=not args.no_shrink, quant=True,
+                mesh_shape=mesh_shape, **qkw
             )
             quant_ok = quant_ok and q_report.ok
             quant_reports[qname] = (q_analysis, q_report)
@@ -268,6 +297,7 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                 "steps_per_dispatch": k,
                 "page_size": args.serving_page_size,
                 "spec_len": args.serving_spec_len,
+                "mesh_shape": mesh_shape,
                 "donated_leaves": analysis.donated_leaves,
                 "aliased_buffers": len(
                     {e.param_number for e in analysis.aliases}

@@ -193,17 +193,91 @@ def audit_config(
     return analysis, report, cost_report(analysis)
 
 
+def parse_mesh_shape(spec: tp.Optional[str]) -> tp.Optional[tp.Dict[str, int]]:
+    """``"tp=2,replica=2"`` -> ``{"tensor": 2, "replica": 2}`` (the
+    --mesh-shape CLI flag for the sharded serving audits). Accepted keys:
+    ``tp``/``tensor``, ``dp``/``replica``, ``fsdp``. jax-free."""
+    if not spec:
+        return None
+    alias = {"tp": "tensor", "tensor": "tensor", "dp": "replica",
+             "replica": "replica", "fsdp": "fsdp"}
+    out: tp.Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        key = alias.get(name.strip())
+        try:
+            size = int(val.strip())
+        except ValueError:
+            size = 0
+        if key is None or size < 1:
+            raise ValueError(
+                f"bad --mesh-shape entry {part!r} (want tp=N / replica=N "
+                "/ fsdp=N with N >= 1)"
+            )
+        out[key] = size
+    return out or None
+
+
+def serving_payload_shapes(
+    model_cfg,
+    *,
+    slots: int,
+    page_size: int,
+    num_pages: int,
+    rows: tp.Iterable[int],
+) -> tp.FrozenSet[tp.Tuple[int, ...]]:
+    """Every FULL (unsharded) shape a serving program's pool/page-gather
+    payload can take at one audited geometry — what the
+    ``no-batch-allgather-in-page-gather`` rule is parameterized with. An
+    all-gather producing one of these in the SPMD-partitioned HLO means
+    a KV-head-sharded buffer was regathered to all heads. ``rows`` lists
+    the per-dispatch row counts the program writes (decode window K,
+    prefill chunk length, verify spec_len + 1)."""
+    from midgpt_tpu.serving.paged import pages_needed
+
+    l = model_cfg.n_layer
+    hkv = model_cfg.kv_heads
+    c = model_cfg.head_dim
+    ps = page_size
+    pmax = pages_needed(model_cfg.block_size, page_size)
+    shapes: tp.Set[tp.Tuple[int, ...]] = {
+        (l, num_pages, hkv, c, ps),  # the pool itself
+        (num_pages, hkv, c, ps),  # one layer's pool
+        (slots, pmax, hkv, c, ps),  # block-table-gathered pages
+        (slots, hkv, c, pmax * ps),  # the reshaped logical KV view
+    }
+    for r in rows:
+        shapes.add((l, slots, hkv, r, c))  # stacked recent/row buffers
+        shapes.add((slots, hkv, r, c))  # one layer's rows
+    return frozenset(shapes)
+
+
 def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
                          page_size: int, shrink: bool,
-                         quant: bool = False):
+                         quant: bool = False,
+                         mesh_shape: tp.Optional[tp.Mapping[str, int]] = None):
     """Shared geometry for the three serving audits (decode window +
     prefill chunk + speculative verify): audit-shrunk model config,
-    1-device mesh, bf16-cast model, page pool and slot logits. ONE
-    definition so the compiled programs can never silently audit
-    different geometries. ``quant=True`` converts the model to the int8
-    quantized serving pytree (midgpt_tpu.quant) and additionally returns
-    its weight-matrix shapes — what the no-dequant-materialization rule
-    is parameterized with (empty when quant is off)."""
+    bf16-cast model, page pool and slot logits. ONE definition so the
+    compiled programs can never silently audit different geometries.
+    ``quant=True`` converts the model to the int8 quantized serving
+    pytree (midgpt_tpu.quant) and additionally returns its weight-matrix
+    shapes — what the no-dequant-materialization rule is parameterized
+    with (empty when quant is off).
+
+    ``mesh_shape`` (e.g. ``{"tensor": 2}``, the --mesh-shape CLI flag)
+    compiles the SHARDED programs instead: a multi-device mesh over the
+    first prod(axes) devices, model committed per GPT_PARAM_RULES (incl.
+    the QuantLinear scale rules), pool KV-head-sharded, logits
+    vocab-sharded — exactly how ``ServingEngine(mesh=...)`` places them,
+    so the audit sees the partitioned HLO the sharded engine launches.
+    The returned ``prog_mesh`` is the mesh to hand the program factories
+    (None for the classic single-chip audit); with quant the returned
+    weight shapes are the per-SHARD local shapes (what the partitioned
+    module actually contains)."""
     import dataclasses as _dc
 
     import jax
@@ -221,39 +295,87 @@ def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
             model_cfg, n_layer=2, block_size=256, vocab_size=1024,
             remat="none", scan_unroll=1,
         )
-    mesh = create_mesh(
-        MeshConfig(replica=1, fsdp=1, sequence=1, tensor=1),
-        devices=jax.devices()[:1],
+    axes = {"replica": 1, "fsdp": 1, "sequence": 1, "tensor": 1}
+    if mesh_shape:
+        unknown = set(mesh_shape) - set(axes)
+        assert not unknown, f"unknown serving mesh axes {sorted(unknown)}"
+        axes.update(mesh_shape)
+    n_dev = 1
+    for v in axes.values():
+        n_dev *= v
+    assert n_dev <= len(jax.devices()), (
+        f"mesh shape {axes} needs {n_dev} devices, have "
+        f"{len(jax.devices())}"
     )
+    mesh = create_mesh(MeshConfig(**axes), devices=jax.devices()[:n_dev])
     model = cast_floating(GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16)
-    wshapes: tp.FrozenSet[tp.Tuple[int, ...]] = frozenset()
     if quant:
-        from midgpt_tpu.quant import quant_weight_shapes, quantize_model
+        from midgpt_tpu.quant import quantize_model
 
         model = quantize_model(model)
-        wshapes = quant_weight_shapes(model)
+    prog_mesh = None
     pmax = pages_needed(model_cfg.block_size, page_size)
-    pool = PagedKVPool.init(model_cfg, slots * pmax, page_size)
-    logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
-    return model_cfg, mesh, model, pmax, pool, logits, wshapes
+    if mesh_shape:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from midgpt_tpu.models.gpt import GPT_PARAM_RULES
+        from midgpt_tpu.parallel.sharding import param_shardings
+
+        tp_sz = axes["tensor"]
+        assert model_cfg.kv_heads % tp_sz == 0, (
+            f"tensor={tp_sz} must divide kv_heads {model_cfg.kv_heads}"
+        )
+        assert model_cfg.vocab_size % tp_sz == 0, (
+            f"tensor={tp_sz} must divide vocab {model_cfg.vocab_size}"
+        )
+        model = jax.device_put(
+            model, param_shardings(mesh, model, GPT_PARAM_RULES)
+        )
+        pool = PagedKVPool.init(
+            model_cfg, slots * pmax, page_size, mesh=mesh
+        )
+        logits = jax.device_put(
+            jnp.zeros((slots, model_cfg.vocab_size), jnp.float32),
+            NamedSharding(mesh, P(None, "tensor")),
+        )
+        prog_mesh = mesh
+    else:
+        pool = PagedKVPool.init(model_cfg, slots * pmax, page_size)
+        logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
+    wshapes: tp.FrozenSet[tp.Tuple[int, ...]] = frozenset()
+    if quant:
+        from midgpt_tpu.quant import quant_weight_shapes
+
+        # after device_put: sharded leaves yield per-shard local shapes
+        wshapes = quant_weight_shapes(model)
+    return model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh
 
 
-def _serving_rules(wshapes) -> "RuleSet":
+def _serving_rules(
+    wshapes,
+    payload_shapes: tp.Optional[tp.FrozenSet] = None,
+    slots: tp.Optional[int] = None,
+) -> "RuleSet":
     """The serving-invariant ruleset all three program audits share:
     donation-intact + no-host-sync + no-f64, plus
     no-dequant-materialization when the program was compiled against the
-    quantized pytree (``wshapes`` non-empty)."""
+    quantized pytree (``wshapes`` non-empty), plus
+    no-batch-allgather-in-page-gather when it was compiled on a sharded
+    mesh (``payload_shapes`` given — see serving_payload_shapes)."""
     from midgpt_tpu.analysis.rules import (
         DonationIntact,
         NoDequantMaterialization,
         NoF64,
         NoHostSync,
+        NoPageGatherAllGather,
         RuleSet,
     )
 
     rules = [NoF64(), DonationIntact(), NoHostSync()]
     if wshapes:
         rules.append(NoDequantMaterialization(wshapes))
+    if payload_shapes:
+        rules.append(NoPageGatherAllGather(payload_shapes, slots or 1))
     return RuleSet(rules)
 
 
@@ -265,6 +387,7 @@ def compile_decode_window(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's fused K-step decode window
     (``midgpt_tpu.serving.make_decode_window``) for ``cfg``'s model —
@@ -280,21 +403,25 @@ def compile_decode_window(
     in HBM) and no host sync hiding inside it (one stray callback stalls
     all K decode steps per launch). ``quant=True`` compiles the int8
     quantized weight path instead (midgpt_tpu.quant) for the
-    no-dequant-materialization rule."""
+    no-dequant-materialization rule. ``mesh_shape`` (e.g.
+    ``{"tensor": 2}``) compiles the TP-SHARDED program the mesh-aware
+    engine launches — head-sharded pool, vocab-sharded logits — and
+    additionally returns the full pool payload shapes the
+    no-batch-allgather-in-page-gather rule needs."""
     import jax
     import numpy as np_
 
     from midgpt_tpu.serving.engine import make_decode_window
 
-    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+    model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=slots, page_size=page_size, shrink=shrink,
-            quant=quant,
+            quant=quant, mesh_shape=mesh_shape,
         )
     )
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
-        rope_len=model_cfg.block_size,
+        rope_len=model_cfg.block_size, mesh=prog_mesh,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = window_fn.lower(
@@ -303,9 +430,17 @@ def compile_decode_window(
         i32(slots), jax.random.PRNGKey(1),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
+    payload = (
+        serving_payload_shapes(
+            model_cfg, slots=slots, page_size=page_size,
+            num_pages=pool.num_pages, rows=(window,),
+        )
+        if prog_mesh is not None
+        else None
+    )
     # return the AUDITED model's block size: with shrink it differs from
     # cfg's, and geometry-dependent rules must see the compiled program's
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
 
 
 def audit_decode_window(
@@ -316,18 +451,21 @@ def audit_decode_window(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call serving audit: compile the fused decode window and check
     the serving invariants (donation-intact, no-host-sync, no-f64 —
-    plus no-dequant-materialization when ``quant``)."""
+    plus no-dequant-materialization when ``quant``, plus
+    no-batch-allgather-in-page-gather when ``mesh_shape`` compiles the
+    sharded program)."""
     cfg = (
         get_config(name_or_cfg)
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes = compile_decode_window(
+    hlo, mesh, donated, block, wshapes, payload = compile_decode_window(
         cfg, slots=slots, window=window, page_size=page_size,
-        shrink=shrink, quant=quant,
+        shrink=shrink, quant=quant, mesh_shape=mesh_shape,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -336,7 +474,7 @@ def audit_decode_window(
         block=block,
         donated_leaves=donated,
     )
-    report = _serving_rules(wshapes).evaluate(analysis)
+    report = _serving_rules(wshapes, payload, slots).evaluate(analysis)
     return analysis, report
 
 
@@ -347,6 +485,7 @@ def compile_prefill_chunk(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's prefill-chunk program
     (``midgpt_tpu.serving.make_prefill_chunk_program``) — the suffix-only
@@ -367,15 +506,16 @@ def compile_prefill_chunk(
 
     from midgpt_tpu.serving.engine import make_prefill_chunk_program
 
-    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+    model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
-            cfg, slots=4, page_size=page_size, shrink=shrink, quant=quant
+            cfg, slots=4, page_size=page_size, shrink=shrink, quant=quant,
+            mesh_shape=mesh_shape,
         )
     )
     assert chunk_len <= model_cfg.block_size, (chunk_len, model_cfg.block_size)
     chunk_fn = make_prefill_chunk_program(
         model, chunk_len=chunk_len, pmax=pmax,
-        rope_len=model_cfg.block_size,
+        rope_len=model_cfg.block_size, mesh=prog_mesh,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = chunk_fn.lower(
@@ -383,7 +523,15 @@ def compile_prefill_chunk(
         i32(pmax),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
+    payload = (
+        serving_payload_shapes(
+            model_cfg, slots=1, page_size=page_size,
+            num_pages=pool.num_pages, rows=(chunk_len,),
+        )
+        if prog_mesh is not None
+        else None
+    )
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
 
 
 def audit_prefill_chunk(
@@ -393,6 +541,7 @@ def audit_prefill_chunk(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call audit of the prefill-chunk program: donation-intact,
     no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
@@ -405,9 +554,9 @@ def audit_prefill_chunk(
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes = compile_prefill_chunk(
+    hlo, mesh, donated, block, wshapes, payload = compile_prefill_chunk(
         cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
-        quant=quant,
+        quant=quant, mesh_shape=mesh_shape,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -416,7 +565,7 @@ def audit_prefill_chunk(
         block=block,
         donated_leaves=donated,
     )
-    report = _serving_rules(wshapes).evaluate(analysis)
+    report = _serving_rules(wshapes, payload, 1).evaluate(analysis)
     return analysis, report
 
 
@@ -428,6 +577,7 @@ def compile_verify_program(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's speculative VERIFY program
     (``midgpt_tpu.serving.make_verify_program``) — the single dispatch
@@ -449,15 +599,15 @@ def compile_verify_program(
 
     from midgpt_tpu.serving.engine import make_verify_program
 
-    model_cfg, mesh, model, pmax, pool, logits, wshapes = (
+    model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=slots, page_size=page_size, shrink=shrink,
-            quant=quant,
+            quant=quant, mesh_shape=mesh_shape,
         )
     )
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
-        rope_len=model_cfg.block_size,
+        rope_len=model_cfg.block_size, mesh=prog_mesh,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = verify_fn.lower(
@@ -466,7 +616,15 @@ def compile_verify_program(
         i32(slots, spec_len), i32(slots),
     ).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes
+    payload = (
+        serving_payload_shapes(
+            model_cfg, slots=slots, page_size=page_size,
+            num_pages=pool.num_pages, rows=(spec_len + 1,),
+        )
+        if prog_mesh is not None
+        else None
+    )
+    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
 
 
 def audit_verify_program(
@@ -477,6 +635,7 @@ def audit_verify_program(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ) -> tp.Tuple[StepAnalysis, Report]:
     """One-call audit of the speculative verify program: donation-intact,
     no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
@@ -488,9 +647,9 @@ def audit_verify_program(
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes = compile_verify_program(
+    hlo, mesh, donated, block, wshapes, payload = compile_verify_program(
         cfg, slots=slots, spec_len=spec_len, page_size=page_size,
-        shrink=shrink, quant=quant,
+        shrink=shrink, quant=quant, mesh_shape=mesh_shape,
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -499,7 +658,7 @@ def audit_verify_program(
         block=block,
         donated_leaves=donated,
     )
-    report = _serving_rules(wshapes).evaluate(analysis)
+    report = _serving_rules(wshapes, payload, slots).evaluate(analysis)
     return analysis, report
 
 

@@ -420,6 +420,81 @@ class NoDequantMaterialization(Rule):
         return out
 
 
+class NoPageGatherAllGather(Rule):
+    """Sharded paged serving (``ServingEngine(mesh=...)``): the KV pool
+    shards by WHOLE KV HEADS, precisely so the block-table page gathers
+    (an index into the replicated page dim) stay shard-local — the one
+    mesh decision that keeps serving dispatch collective costs at two
+    activation-row psums per layer. The footgun this rule gates
+    (ROADMAP item 1 named it when the work was scoped): one missing or
+    wrong sharding constraint around the gather and the partitioner
+    "helps" by all-gathering the pool payload (every page of every head
+    onto every chip — the KV stream times tp) or the whole per-slot
+    batch through the gather, silently erasing the memory/bandwidth
+    split the mesh exists for while the engine still reports tp > 1.
+
+    Checked against the compiled (SPMD-partitioned, local-shape) HLO,
+    parameterized with the FULL (unsharded) pool/page-gather payload
+    shapes for the audited geometry (``serving_payload_shapes``) and the
+    slot count:
+
+    - no floating-point all-gather result takes a full payload shape
+      (a per-shard payload regathered to all heads);
+    - no rank>=3 floating-point all-gather gathers dim 0 of a
+      slot-batched activation (slot/batch dim >= slots) — slot state is
+      replicated by design (DP is shared-nothing replicas, not a
+      sharded slot axis), so any batch-dim gather means an activation
+      was left unconstrained through the page gather.
+
+    Integer gathers (block tables, index plumbing) are never flagged —
+    they are the replicated index arrays the design feeds every shard."""
+
+    name = "no-batch-allgather-in-page-gather"
+    description = "page gathers stay shard-local: no pool/batch all-gather"
+
+    def __init__(
+        self,
+        payload_shapes: tp.Iterable[tp.Tuple[int, ...]],
+        slots: int,
+    ):
+        self.payload_shapes = frozenset(
+            tuple(int(d) for d in s) for s in payload_shapes
+        )
+        assert self.payload_shapes, "need the pool payload shapes"
+        assert slots >= 1, slots
+        self.slots = slots
+
+    def check(self, a: StepAnalysis) -> tp.List[Violation]:
+        out = []
+        for c in a.collectives:
+            if c.kind != "all-gather":
+                continue
+            for dtype, shape in c.result_shapes:
+                if dtype not in _FLOAT_DTYPES:
+                    continue
+                if shape in self.payload_shapes:
+                    out.append(self.violation(
+                        "pool-payload all-gather: a KV-head-sharded "
+                        f"page buffer regathered to full shape {shape} "
+                        f"(op {c.op_name or '?'}) — the block-table "
+                        "gather must stay shard-local",
+                        c.line,
+                    ))
+                elif (
+                    len(shape) >= 3
+                    and 0 in c.dims
+                    and shape[0] >= self.slots
+                ):
+                    out.append(self.violation(
+                        "slot/batch-dim all-gather of an activation "
+                        f"{shape} (op {c.op_name or '?'}) in a sharded "
+                        "serving program — slot state is replicated by "
+                        "design, nothing may gather it",
+                        c.line,
+                    ))
+        return out
+
+
 class DonationIntact(Rule):
     """``donate_argnums`` actually stuck: the executable aliases at least
     ``donated_leaves`` parameter buffers to outputs. XLA silently drops

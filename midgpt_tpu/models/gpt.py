@@ -336,6 +336,12 @@ class Attention:
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = apply_rotary(q, sin_row, cos_row)
         k = apply_rotary(k, sin_row, cos_row)
+        # no sharding constraints HERE: this helper is shared with the
+        # fixed-batch sampler's ring paths (decode_at/decode_recent_at),
+        # which run under the TRAINING rule table with the batch dim
+        # sharded — a serving-style batch-replicated pin would force a
+        # per-layer-per-token reshard there. The paged serving caller
+        # (decode_paged_at) applies its whole-head TP constraints itself.
         return q, k, v
 
     def decode_at(
@@ -439,6 +445,13 @@ class Attention:
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
         q, k, v = self._decode_qkv(x, sin_rows, cos_rows)
+        # whole-head TP (serving meshes, serving_logical_rules): the
+        # slot dim stays replicated — DP is shared-nothing engine
+        # replicas, not a sharded slot axis — and every per-head tensor
+        # splits over 'tensor'. No-ops outside an axis_rules scope.
+        q = shard_act(q, None, "heads", None, None)
+        k = shard_act(k, None, "kv_heads", None, None)
+        v = shard_act(v, None, "kv_heads", None, None)
         zero = jnp.zeros((), r.dtype)
         at = (jnp.asarray(layer, r.dtype), zero, zero, r, zero)
         rk = jax.lax.dynamic_update_slice(rk, k.astype(rk.dtype)[None], at)
@@ -454,6 +467,15 @@ class Attention:
         s_, pmax, _, _, ps = pk_l.shape
         ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
         cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        # the block-table gather indexes the (replicated) page dim of a
+        # KV-head-sharded pool, so it is shard-local: each device gathers
+        # its own heads' pages. Pin the gathered view so the partitioner
+        # can never "help" by regathering heads (the batch-allgather
+        # footgun the no-batch-allgather-in-page-gather audit rule gates).
+        ck = shard_act(ck, None, "kv_heads", None, None)
+        cv = shard_act(cv, None, "kv_heads", None, None)
+        rk = shard_act(rk, None, None, "kv_heads", None, None)
+        rv = shard_act(rv, None, None, "kv_heads", None, None)
         rkl, rvl = rk[layer], rv[layer]  # [S, Hkv, R, C]
         qg = q.reshape(b, hkv, h // hkv, 1, c)
         qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [S, Hkv, G, C, 1]
@@ -481,6 +503,10 @@ class Attention:
         out = (o_pool + o_rec).astype(x.dtype)
         out = out.reshape(b, h, 1, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
+        # merged [.., H*C] stays head-contiguous tensor-sharded: wo is
+        # row-parallel (GPT_PARAM_RULES), so the contraction runs on
+        # local heads and GSPMD inserts ONE psum on the [.., D] result
+        out = shard_act(out, None, None, "heads")
         return self.wo(out), rk, rv
 
     def prefill_paged_at(
@@ -533,6 +559,11 @@ class Attention:
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = apply_rotary(q, sin_rows, cos_rows)
         k = apply_rotary(k, sin_rows, cos_rows)
+        # whole-head TP: per-head tensors split over 'tensor', the slot
+        # dim replicated (see _decode_qkv)
+        q = shard_act(q, None, "heads", None, None)
+        k = shard_act(k, None, "kv_heads", None, None)
+        v = shard_act(v, None, "kv_heads", None, None)
         # gather the slot's pages (clip-mode for the same NaN reason as
         # decode_paged_at) -> logical KV [1, Hkv, C, W] in page order
         pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
@@ -540,6 +571,8 @@ class Attention:
         _, pmax, _, _, ps = pk_l.shape
         ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
         cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        ck = shard_act(ck, None, "kv_heads", None, None)
+        cv = shard_act(cv, None, "kv_heads", None, None)
         qg = q.reshape(b, hkv, h // hkv, t, c)
         s_pool = jnp.einsum(
             "bhgtc,bhcw->bhgtw", qg, ck.astype(qg.dtype),
@@ -561,6 +594,8 @@ class Attention:
         o_self = jnp.einsum("bhgts,bhsc->bhgtc", p_self, v)
         out = (o_pool + o_self).reshape(b, h, t, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+        # head-contiguous merged dim feeds the row-parallel wo (one psum)
+        out = shard_act(out, None, None, "heads")
         return self.wo(out.astype(x.dtype)), k, v
 
     def verify_paged_at(
@@ -608,6 +643,11 @@ class Attention:
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = apply_rotary(q, sin_rows, cos_rows)
         k = apply_rotary(k, sin_rows, cos_rows)
+        # whole-head TP: per-head tensors split over 'tensor', the slot
+        # dim replicated (see _decode_qkv)
+        q = shard_act(q, None, "heads", None, None)
+        k = shard_act(k, None, "kv_heads", None, None)
+        v = shard_act(v, None, "kv_heads", None, None)
         # gather the slots' pages (clip-mode for the same NaN reason as
         # decode_paged_at) -> logical KV [S, Hkv, C, W] in page order
         pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
@@ -615,6 +655,8 @@ class Attention:
         _, pmax, _, _, ps = pk_l.shape
         ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
         cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        ck = shard_act(ck, None, "kv_heads", None, None)
+        cv = shard_act(cv, None, "kv_heads", None, None)
         qg = q.reshape(b, hkv, h // hkv, t, c)  # [S, Hkv, G, T, C]
         # the decode window stores each step's K/V into the CACHE-dtype
         # recent buffer and reads it back for the in-window scores — so
@@ -654,6 +696,8 @@ class Attention:
         out = (o_pool + o_self).astype(x.dtype)
         out = out.reshape(b, h, t, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+        # head-contiguous merged dim feeds the row-parallel wo (one psum)
+        out = shard_act(out, None, None, "heads")
         return self.wo(out), k, v
 
     def decode_recent_at(
@@ -1504,6 +1548,10 @@ def decode_step_paged(
     pmax = bt.shape[1]
     ps = pool_k.shape[-1]
     rr = rk.shape[3]
+    # KV-head-sharded pool (TP serving): pages and the time dim stay
+    # whole per shard, so every block-table gather below is shard-local
+    pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
+    pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1532,7 +1580,9 @@ def decode_step_paged(
             sin_h, cos_h,
         )
     h = model.ln_f(h)
-    logits = model.project(h)[:, 0, :]  # [S, V]
+    # vocab-sharded logits (TP lm head is column-parallel): nothing here
+    # gathers the [S, V] row — greedy argmax partitions over 'tensor'
+    logits = shard_act(model.project(h)[:, 0, :], None, "vocab")  # [S, V]
     return logits, rk, rv
 
 
@@ -1569,6 +1619,8 @@ def prefill_chunk_paged(
     assert b == 1, f"chunk prefill is per-slot, got batch {b}"
     pmax = bt.shape[1]
     ps = pool_k.shape[-1]
+    pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
+    pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1596,7 +1648,9 @@ def prefill_chunk_paged(
         ks.append(k)
         vs.append(v)
     h = model.ln_f(h)
-    return h, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, 1, Hkv, T, C]
+    ks = shard_act(jnp.stack(ks), None, None, "kv_heads", None, None)
+    vs = shard_act(jnp.stack(vs), None, None, "kv_heads", None, None)
+    return h, ks, vs  # ks/vs: [L, 1, Hkv, T, C]
 
 
 def verify_tokens_paged(
@@ -1638,6 +1692,8 @@ def verify_tokens_paged(
     s, t = tokens.shape
     pmax = bt.shape[1]
     ps = pool_k.shape[-1]
+    pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
+    pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1667,8 +1723,12 @@ def verify_tokens_paged(
         ks.append(k)
         vs.append(v)
     h = model.ln_f(h)
-    logits = model.project(h)  # [S, T, V]
-    return logits, jnp.stack(ks), jnp.stack(vs)  # ks/vs: [L, S, Hkv, T, C]
+    # vocab-sharded per-row logits (column-parallel head) — acceptance
+    # argmaxes partition over 'tensor', no gathered [S, T, V] buffer
+    logits = shard_act(model.project(h), None, None, "vocab")  # [S, T, V]
+    ks = shard_act(jnp.stack(ks), None, None, "kv_heads", None, None)
+    vs = shard_act(jnp.stack(vs), None, None, "kv_heads", None, None)
+    return logits, ks, vs  # ks/vs: [L, S, Hkv, T, C]
 
 
 def merge_recent(
@@ -1778,6 +1838,18 @@ GPT_PARAM_RULES: tp.Sequence[tp.Tuple[str, P]] = (
     (r"attn/(q|k)_norm/weight", P()),
     (r"mlp/w_(up|gate)/weight", P("fsdp", "tensor")),
     (r"mlp/w_down/weight", P("tensor", "fsdp")),
+    # QuantLinear per-OUTPUT-channel dequant scales (midgpt_tpu.quant,
+    # the int8 serving pytree): a scale vector [L, out] / [out] must
+    # shard exactly like its weight's OUT dim, or the fused epilogue
+    # multiply regathers the activation it scales. Column-parallel
+    # weights (out over tensor) -> scale over tensor; row-parallel
+    # weights (out over fsdp) -> scale over fsdp. Right-aligned, so the
+    # same rule covers stacked [L, out] and the unstacked head [out].
+    (r"attn/wqkv/scale", P("tensor")),
+    (r"attn/wo/scale", P("fsdp")),
+    (r"mlp/w_(up|gate)/scale", P("tensor")),
+    (r"mlp/w_down/scale", P("fsdp")),
+    (r"lm_head/scale", P("tensor")),
     # MoE (mlp="moe"): experts over 'tensor' (expert parallelism), the
     # dense dims over fsdp (ZeRO); the tiny [D, E] router replicated.
     # Right-aligned onto the stacked [L, E, D, F] / [L, E, F, D] leaves.

@@ -48,6 +48,17 @@ What stays full-precision: the token embedding (a gather, not a
 matmul), the tiny QK-norm / RMSNorm scales, and MoE expert stacks
 (``mlp="moe"`` is a training configuration; the serving configs are
 dense — quantize_model asserts).
+
+Tensor parallelism (the TP serving engine, ``ServingEngine(mesh=...)``):
+a QuantLinear shards exactly like the Linear it replaced — the int8
+weight takes the weight rule (column-parallel wqkv/w_up/gate/lm_head,
+row-parallel wo/w_down) and the scale vector splits along the SAME out
+dim (``GPT_PARAM_RULES`` has explicit ``.../scale`` entries), so the
+epilogue multiply stays a local row-broadcast on every shard and the
+per-chip int8 stream is 1/tp of the whole model. Exactness composes:
+column-parallel epilogues are bitwise per output column, and the po2
+contract is per-channel, so the quantized TP engine relates to the
+dequantized TP engine exactly as in the single-chip case.
 """
 
 from __future__ import annotations
@@ -226,12 +237,26 @@ def quant_weight_shapes(model: GPT) -> tp.FrozenSet[tp.Tuple[int, ...]]:
     compiled program: the stacked ``[L, in, out]`` leaves AND their
     static per-layer ``[in, out]`` slices (the serving programs' layer
     loops slice statically). The ``no-dequant-materialization`` audit
-    flags any full-precision buffer/multiply at one of these shapes."""
+    flags any full-precision buffer/multiply at one of these shapes.
+
+    Sharding-aware: when the model's leaves carry a ``NamedSharding``
+    (the TP serving path — GPT_PARAM_RULES splits each QuantLinear's
+    weight over 'tensor' and its scale vector consistently along the
+    same OUT dim), the shapes returned are the per-shard LOCAL shapes,
+    because that is what the SPMD-partitioned HLO the audit parses
+    actually contains. Unsharded models are unchanged (the local shape
+    IS the global shape)."""
     shapes: tp.Set[tp.Tuple[int, ...]] = set()
+
+    def _local_shape(arr) -> tp.Tuple[int, ...]:
+        sharding = getattr(arr, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            return tuple(int(d) for d in sharding.shard_shape(arr.shape))
+        return tuple(int(d) for d in arr.shape)
 
     def _collect(leaf):
         if isinstance(leaf, QuantLinear):
-            s = tuple(int(d) for d in leaf.weight.shape)
+            s = _local_shape(leaf.weight)
             shapes.add(s)
             if len(s) > 2:
                 shapes.add(s[1:])  # the static layer slice

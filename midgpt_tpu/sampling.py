@@ -63,7 +63,15 @@ def sample_token(
     Shared by the fixed-batch sampler below and the serving engine's
     decode window; the serving VERIFY program's acceptance check is the
     ``temperature == 0`` branch of this function applied per candidate
-    row — which is why speculation is exactly greedy-equivalent."""
+    row — which is why speculation is exactly greedy-equivalent.
+
+    Under a tensor-parallel serving mesh ``logits`` arrives
+    VOCAB-SHARDED: the greedy branch partitions cleanly (per-shard
+    argmax + a [B, tp]-sized combiner gather — the only thing that ever
+    crosses chips is one (value, index) pair per shard, never the row).
+    The temperature branch's top-k sort and categorical draw may gather
+    the row per slot — correct, but the gathered-row-free contract is
+    greedy-only (the sharded-serving audits gate the greedy programs)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature

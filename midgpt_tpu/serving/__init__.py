@@ -23,6 +23,12 @@ Public surface:
   decoding: draft-model-free n-gram drafting plus the single-dispatch
   paged verification program (``ServingEngine(speculate=N)``; audited
   next to the other two serving programs).
+- :class:`~midgpt_tpu.serving.cluster.ServingCluster`,
+  :func:`~midgpt_tpu.serving.cluster.serving_meshes` — TPxDP: the engine
+  shards its model/KV pool over a tensor-only mesh
+  (``ServingEngine(mesh=...)``, whole-KV-head pool sharding), and the
+  cluster runs N shared-nothing engine replicas (least-loaded admission,
+  per-replica prefix caches, aggregated stats) above it.
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -33,6 +39,7 @@ import typing as tp
 
 import numpy as np
 
+from midgpt_tpu.serving.cluster import ServingCluster, serving_meshes
 from midgpt_tpu.serving.engine import (
     Request,
     ServingEngine,
@@ -60,8 +67,10 @@ __all__ = [
     "PrefixIndex",
     "Proposer",
     "Request",
+    "ServingCluster",
     "ServingEngine",
     "copy_page",
+    "serving_meshes",
     "flush_recent",
     "generate_served",
     "make_copy_page_program",

@@ -1,0 +1,226 @@
+"""Shared-nothing data-parallel serving: N independent engine replicas
+under one admission scheduler.
+
+Tensor parallelism (``ServingEngine(mesh=...)``) scales a single engine
+DOWN the latency axis — the model's weights and KV pool split over the
+'tensor' axis of ONE mesh, every dispatch runs collectives. Data
+parallelism scales UP the throughput axis, and for serving the right
+shape is SHARED-NOTHING: each replica is a complete ``ServingEngine``
+owning its own devices (or mesh slice), page pool, prefix cache, and
+scheduler state, with no collective ever crossing replicas — a replica
+failure or a slow request affects only its own slots, and replicas can
+be added/removed without recompiling anything (the Gemma-on-TPU serving
+comparison and the pjit scaling study, PAPERS.md, both benchmark exactly
+this TPxDP composition).
+
+:class:`ServingCluster` is the scheduler above the replicas:
+
+- **Least-loaded admission**: ``submit`` routes each request to the
+  replica with the smallest backlog (queued + active requests;
+  deterministic lowest-index tie-break). Because every engine's token
+  stream is a function of the request alone (the determinism contract in
+  ``serving.engine``), placement NEVER changes a request's tokens — only
+  its latency — which the cluster test asserts directly.
+- **Per-replica prefix caches**: no cross-replica page sharing (pages
+  live in per-replica pools on disjoint devices). A shared-prefix mix
+  therefore hits best when co-located; the least-loaded policy is
+  deliberately content-blind — smarter affinity routing is a policy
+  plug-in point, not an engine change.
+- **Aggregated stats**: :meth:`stats` sums the per-engine counters and
+  keeps the per-replica breakdown, in the same key layout as
+  ``ServingEngine.stats`` (bench_serving emits it unchanged).
+
+This is the seam the async front door (ROADMAP item 5) slots into:
+streaming/cancellation/priorities wrap ``submit``/``step`` here without
+touching the engines.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import typing as tp
+
+import numpy as np
+
+from midgpt_tpu.serving.engine import Request, ServingEngine
+
+
+def serving_meshes(
+    tp_size: int = 1,
+    dp_replicas: int = 1,
+    devices: tp.Optional[tp.Sequence] = None,
+) -> tp.List:
+    """Disjoint tensor-only meshes for a TPxDP serving deployment: the
+    first ``tp_size * dp_replicas`` devices split into ``dp_replicas``
+    contiguous groups of ``tp_size`` (contiguous = ICI-adjacent under the
+    standard device enumeration, the layout the pjit scaling study uses
+    for its TP groups). ``tp_size == 1`` with one replica returns
+    ``[None]`` — the engine's single-chip fast path, no mesh machinery at
+    all; multi-replica tp=1 gets real 1-device meshes so each replica's
+    arrays COMMIT to its own device instead of piling onto device 0."""
+    import jax
+
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.parallel.mesh import create_mesh
+
+    assert tp_size >= 1 and dp_replicas >= 1, (tp_size, dp_replicas)
+    if tp_size == 1 and dp_replicas == 1:
+        return [None]
+    devices = list(devices) if devices is not None else jax.devices()
+    need = tp_size * dp_replicas
+    assert len(devices) >= need, (
+        f"tp={tp_size} x dp_replicas={dp_replicas} needs {need} devices, "
+        f"have {len(devices)}"
+    )
+    cfg = MeshConfig(replica=1, fsdp=1, sequence=1, tensor=tp_size)
+    return [
+        create_mesh(cfg, devices=devices[i * tp_size : (i + 1) * tp_size])
+        for i in range(dp_replicas)
+    ]
+
+
+class ServingCluster:
+    """N shared-nothing :class:`ServingEngine` replicas + least-loaded
+    admission. The cluster's request ids are its own (monotone, globally
+    unique); per-replica ids stay internal.
+
+    ``meshes`` pins each replica to its own mesh (``serving_meshes``
+    builds the standard TPxDP split); ``replicas=N`` without meshes runs
+    N schedulers on the default device — still useful: it is the
+    scheduler-correctness configuration the tests drive, and the
+    single-host shape the async front door (ROADMAP item 5) will
+    multiplex. All other keyword arguments go to every engine verbatim.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        replicas: tp.Optional[int] = None,
+        meshes: tp.Optional[tp.Sequence] = None,
+        **engine_kwargs,
+    ):
+        if meshes is None:
+            assert replicas is not None and replicas >= 1, (
+                "need replicas=N or an explicit meshes= list"
+            )
+            meshes = [None] * replicas
+        else:
+            meshes = list(meshes)
+            assert replicas is None or replicas == len(meshes), (
+                f"replicas={replicas} contradicts {len(meshes)} meshes"
+            )
+        assert len(meshes) >= 1
+        self.engines: tp.List[ServingEngine] = [
+            ServingEngine(model, mesh=m, **engine_kwargs) for m in meshes
+        ]
+        # global rid -> (replica index, engine-local rid)
+        self._route: tp.Dict[int, tp.Tuple[int, int]] = {}
+        self._next_rid = 0
+        self.finished: tp.Dict[int, Request] = {}
+        # one stepping thread per replica: ServingEngine.step blocks on
+        # its window's device->host read, and a sequential loop would
+        # keep replica B's devices idle while replica A's window
+        # computes — time-multiplexing the "parallel" replicas. Engines
+        # share no state (that is the design), jax dispatch/blocking
+        # reads release the GIL, and each engine only ever runs on ONE
+        # thread at a time (submit/step/run are driven from the caller's
+        # thread; the pool just fans one step() per engine out).
+        self._pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(self.engines),
+                thread_name_prefix="serving-replica",
+            )
+            if len(self.engines) > 1
+            else None
+        )
+
+    @property
+    def replicas(self) -> int:
+        return len(self.engines)
+
+    def _load(self, e: ServingEngine) -> int:
+        """Backlog of one replica: queued + in-flight requests. Counting
+        requests (not tokens) keeps admission O(1) and deterministic;
+        remaining-token estimates are a policy refinement the seam
+        allows."""
+        return len(e.queue) + len(e._active_slots())
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        *,
+        eos_id: tp.Optional[int] = None,
+        seed: int = 0,
+    ) -> int:
+        """Admit onto the least-loaded replica (lowest index on ties —
+        deterministic, so a test trace routes identically every run);
+        returns the cluster-global request id."""
+        i = min(
+            range(len(self.engines)),
+            key=lambda j: (self._load(self.engines[j]), j),
+        )
+        local = self.engines[i].submit(
+            prompt, max_new_tokens, eos_id=eos_id, seed=seed
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._route[rid] = (i, local)
+        return rid
+
+    def _harvest(self) -> None:
+        for rid, (i, local) in list(self._route.items()):
+            req = self.engines[i].finished.get(local)
+            if req is not None:
+                self.finished[rid] = req
+                del self._route[rid]
+
+    def step(self) -> bool:
+        """One scheduler window on EVERY replica, dispatched
+        CONCURRENTLY (one thread per engine): each engine's step blocks
+        on its own device->host read, so the threads overlap the
+        replicas' windows on their disjoint devices — aggregate
+        throughput scales with replicas instead of time-multiplexing
+        them. Returns True while any replica has (or had) work."""
+        if self._pool is None:
+            progressed = self.engines[0].step()
+        else:
+            progressed = any(
+                list(self._pool.map(lambda e: e.step(), self.engines))
+            )
+        self._harvest()
+        return progressed
+
+    def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
+        """Drive :meth:`step` until every replica drains; returns the
+        finished requests by cluster-global id."""
+        for _ in range(max_windows):
+            if not any(
+                e.queue or e._active_slots() for e in self.engines
+            ):
+                break
+            self.step()
+        else:
+            raise RuntimeError(
+                f"cluster did not drain in {max_windows} windows"
+            )
+        self._harvest()
+        return self.finished
+
+    def stats(self) -> tp.Dict[str, tp.Any]:
+        """Summed engine counters (ServingEngine.stats key layout) plus
+        ``dp_replicas`` and the ``per_replica`` breakdown."""
+        per = [e.stats() for e in self.engines]
+        agg: tp.Dict[str, tp.Any] = {}
+        for k in per[0]:
+            if k in ("slot_occupancy", "prefix_hit_rate",
+                     "tokens_per_dispatch", "spec_acceptance_rate"):
+                agg[k] = round(sum(s[k] for s in per) / len(per), 4)
+            elif k == "tp":
+                agg[k] = per[0][k]
+            else:
+                agg[k] = sum(s[k] for s in per)
+        agg["dp_replicas"] = len(per)
+        agg["per_replica"] = per
+        return agg

@@ -108,6 +108,44 @@ Array = jax.Array
 _PROGRAM_CACHE: tp.Dict[tp.Tuple, tp.Any] = {}
 
 
+def serving_logical_rules() -> tp.Dict[str, tp.Any]:
+    """The activation logical-rule table the serving programs compile
+    under: the training table with 'batch' and 'seq' unmapped. Inside
+    ONE engine the slot dim is NEVER a sharded axis — data parallelism
+    is shared-nothing engine replicas (serving.cluster), and a
+    replica/fsdp axis on the engine's own mesh must ride replicated.
+    (Left on the training mapping, the model's generic
+    ``shard_act(x, 'batch', ...)`` tags would shard slots over
+    'replica', and the partitioner then bounces every per-slot
+    activation between sharded and replicated through the page
+    gathers — the exact batch all-gather the
+    no-batch-allgather-in-page-gather audit rule flags; found by that
+    rule on the first tp=2,replica=2 audit.) 'seq' is unmapped for the
+    same reason: decode is one token deep and a prefill chunk is one
+    slot wide — there is nothing to shard."""
+    from midgpt_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES
+
+    return {**DEFAULT_LOGICAL_RULES, "batch": None, "seq": None}
+
+
+def _mesh_key(mesh) -> tp.Optional[tp.Tuple]:
+    """Explicit cache fingerprint of a serving mesh: axis names/sizes AND
+    the concrete device ids. Program identity depends on both — a tp=2
+    engine must never reuse a tp=1 program (different partitioning), and
+    two DP replicas pinned to disjoint device sets must not share a
+    wrapper either (same geometry, different placement — jax.jit would
+    recompile per sharding anyway, but sharing the wrapper would
+    interleave two replicas' executable caches and hide placement bugs
+    from the cache-distinctness test). ``None`` stays ``None`` (the
+    single-chip path)."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.shape.items()),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+
+
 def _cached_program(key: tp.Tuple, build: tp.Callable[[], tp.Any]):
     fn = _PROGRAM_CACHE.get(key)
     if fn is None:
@@ -130,7 +168,7 @@ def make_decode_window(
 ):
     key = (
         "decode_window", model.config, slots, window, pmax, rope_len,
-        pad_id, temperature, top_k, mesh,
+        pad_id, temperature, top_k, _mesh_key(mesh),
     )
     return _cached_program(
         key,
@@ -171,7 +209,7 @@ def _build_decode_window(
     mid-prefill ride the same way (``done`` carries them), so chunked
     prefill and decode interleave without a second program shape.
     """
-    from midgpt_tpu.parallel.sharding import axis_rules
+    from midgpt_tpu.parallel.sharding import axis_rules, shard_act
     from midgpt_tpu.sampling import sample_token
 
     rshape = (cfg.n_layer, slots, cfg.kv_heads, window, cfg.head_dim)
@@ -197,7 +235,7 @@ def _build_decode_window(
         assert bt.shape == (slots, pmax), (
             f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
         )
-        with axis_rules(mesh):
+        with axis_rules(mesh, serving_logical_rules()):
             rk = jnp.zeros(rshape, pool.k.dtype)
             rv = jnp.zeros(rshape, pool.k.dtype)
 
@@ -253,6 +291,10 @@ def _build_decode_window(
                 pool, rk, rv, bt, pooled_len, jnp.transpose(wvalid)
             )
             new_len = pooled_len + jnp.sum(wvalid.astype(jnp.int32), axis=0)
+            # pin the donated logits carry vocab-sharded on the way out
+            # (same spec the engine committed the input with — donation
+            # silently drops if the output resharded)
+            logits = shard_act(logits, None, "vocab")
         return pool, logits, toks, emit, done, new_len, emitted
 
     return jax.jit(window_fn, donate_argnums=(1, 2))
@@ -262,7 +304,8 @@ def make_prefill_chunk_program(
     model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None
 ):
     key = (
-        "prefill_chunk", model.config, chunk_len, pmax, rope_len, mesh,
+        "prefill_chunk", model.config, chunk_len, pmax, rope_len,
+        _mesh_key(mesh),
     )
     return _cached_program(
         key,
@@ -287,7 +330,7 @@ def _build_prefill_chunk_program(
     the serving hot path). One compile per padded chunk length — the
     engine buckets chunks to powers-of-two page counts, and fixed-size
     chunking hits a single bucket in steady state."""
-    from midgpt_tpu.parallel.sharding import axis_rules
+    from midgpt_tpu.parallel.sharding import axis_rules, shard_act
 
     assert chunk_len <= cfg.block_size, (chunk_len, cfg.block_size)
 
@@ -302,7 +345,7 @@ def _build_prefill_chunk_program(
         real_n: Array,  # [] int32 — real tokens in this chunk
         bt_row: Array,  # [pmax] int32 — the slot's block table
     ):
-        with axis_rules(mesh):
+        with axis_rules(mesh, serving_logical_rules()):
             h, ks, vs = prefill_chunk_paged(
                 model, tokens, start, pool.k, pool.v, bt_row[None, :],
                 rope_len,
@@ -313,10 +356,15 @@ def _build_prefill_chunk_program(
             h_last = jax.lax.dynamic_slice_in_dim(
                 h, real_n - 1, 1, axis=1
             )[:, 0]  # [1, D]
-            row = model.project(h_last).astype(logits.dtype)[0]
+            # vocab-sharded row update at vocab offset 0 (full-width on
+            # the sharded dim: shard-local), keeping the donated logits
+            # buffer on its committed sharding
+            row = shard_act(model.project(h_last), None, "vocab")
+            row = row.astype(logits.dtype)[0]
             logits = jax.lax.dynamic_update_slice(
                 logits, row[None], (slot, jnp.zeros((), slot.dtype))
             )
+            logits = shard_act(logits, None, "vocab")
         return pool, logits
 
     return jax.jit(chunk_fn, donate_argnums=(1, 2))
@@ -334,7 +382,7 @@ def make_verify_program(
 ):
     key = (
         "verify", model.config, slots, spec_len, pmax, rope_len, pad_id,
-        mesh,
+        _mesh_key(mesh),
     )
     return _cached_program(
         key,
@@ -381,7 +429,7 @@ def _build_verify_program(
     budget counts emitted tokens, an emitted EOS is kept and everything
     after it dropped, and a terminal token's K/V row is not written (no
     real token can follow it)."""
-    from midgpt_tpu.parallel.sharding import axis_rules
+    from midgpt_tpu.parallel.sharding import axis_rules, shard_act
 
     assert spec_len >= 1, spec_len
     t = spec_len + 1
@@ -403,7 +451,7 @@ def _build_verify_program(
         assert bt.shape == (slots, pmax), (
             f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
         )
-        with axis_rules(mesh):
+        with axis_rules(mesh, serving_logical_rules()):
             # row 0: the true next token, materialized from the carried
             # logits (greedy — the same argmax the window's step 0 takes)
             t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -453,6 +501,10 @@ def _build_verify_program(
             new_logits = jnp.take_along_axis(
                 all_logits, last[:, None, None], axis=1
             )[:, 0].astype(logits.dtype)
+            # the take_along_axis indexes the (replicated) row dim of a
+            # vocab-sharded [S, T, V]; pin the carry so the donated
+            # logits buffer keeps its committed sharding
+            new_logits = shard_act(new_logits, None, "vocab")
             # accepted = drafts the MODEL agreed with (pre-EOS/budget
             # truncation): the honest acceptance signal for adaptation —
             # end-of-generation budget clipping is not a drafting miss
@@ -606,6 +658,50 @@ class ServingEngine:
             f"page_size {page_size} must divide block_size {cfg.block_size}"
         )
         assert prefill_chunk is None or prefill_chunk >= 1
+        # tensor-parallel serving mesh: shard the model per
+        # GPT_PARAM_RULES (column-parallel wqkv/w_up(/gate)/lm_head,
+        # row-parallel wo/w_down, quant scales split with their out
+        # dim), the KV pool by WHOLE KV HEADS, and the carried logits by
+        # vocab. Sequence/pipeline axes have no serving decomposition
+        # here (decode is one token deep; DP is shared-nothing engine
+        # replicas — serving.cluster — not a sharded slot axis), so a
+        # serving mesh is tensor-only (extra replica/fsdp axes are
+        # tolerated but simply ride replicated).
+        self.tp = 1
+        if mesh is not None:
+            from midgpt_tpu.models.gpt import (
+                GPT_PARAM_RULES,
+                mlp_hidden_dim,
+            )
+            from midgpt_tpu.parallel.sharding import param_shardings
+
+            assert mesh.shape.get("sequence", 1) == 1, (
+                "serving meshes cannot shard 'sequence' (decode is one "
+                "token deep); use a tensor-only mesh"
+            )
+            assert mesh.shape.get("pipeline", 1) == 1, (
+                "serving meshes cannot shard 'pipeline'; use a "
+                "tensor-only mesh"
+            )
+            tp_sz = mesh.shape.get("tensor", 1)
+            assert (
+                cfg.n_head % tp_sz == 0 and cfg.kv_heads % tp_sz == 0
+            ), (
+                f"tensor={tp_sz} must divide heads "
+                f"({cfg.n_head}/{cfg.kv_heads}): the pool shards whole "
+                "KV heads"
+            )
+            assert cfg.vocab_size % tp_sz == 0, (
+                f"tensor={tp_sz} must divide vocab_size {cfg.vocab_size}"
+            )
+            assert mlp_hidden_dim(cfg) % tp_sz == 0, (
+                f"tensor={tp_sz} must divide the MLP hidden width "
+                f"{mlp_hidden_dim(cfg)}"
+            )
+            self.tp = tp_sz
+            model = jax.device_put(
+                model, param_shardings(mesh, model, GPT_PARAM_RULES)
+            )
         self.model = model
         self.slots = slots
         self.window = window
@@ -648,8 +744,16 @@ class ServingEngine:
         # window, spec_len + 1 candidate rows for the verify program —
         # page growth provisions this many
         self._grow = (self.speculate + 1) if self.speculate else window
-        self.pool = PagedKVPool.init(cfg, num_pages, page_size, cache_dtype)
+        self.pool = PagedKVPool.init(
+            cfg, num_pages, page_size, cache_dtype, mesh=mesh
+        )
         self.logits = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.logits = jax.device_put(
+                self.logits, NamedSharding(mesh, P(None, "tensor"))
+            )
         self._key = jax.random.PRNGKey(seed)
         self._sentinel = num_pages
         self._mesh = mesh
@@ -1308,6 +1412,7 @@ class ServingEngine:
     def stats(self) -> tp.Dict[str, float]:
         occ = self.occupancy_sum / max(1, self.windows * self.slots)
         return {
+            "tp": self.tp,
             "decode_dispatches": self.decode_dispatches,
             "prefill_dispatches": self.prefill_dispatches,
             "copy_dispatches": self.copy_dispatches,

@@ -33,9 +33,18 @@ import jax
 import jax.numpy as jnp
 
 from midgpt_tpu.config import ModelConfig
+from midgpt_tpu.parallel.sharding import shard_act
 from midgpt_tpu.pytree import module, static
 
 Array = jax.Array
+
+# mesh layout of the pool arrays [L, NP, Hkv, C, PS] under tensor
+# parallelism: WHOLE-KV-HEAD sharding — pages, the in-page time dim and
+# head_dim stay intact per shard, so block-table gathers (an index into
+# the replicated page dim) and page scatters are shard-local; only the
+# head dim splits. Batch/page index arrays (block tables, pooled_len,
+# masks) are replicated.
+POOL_SPEC_AXES = (None, None, "kv_heads", None, None)
 
 
 @module
@@ -49,15 +58,32 @@ class PagedKVPool:
 
     @staticmethod
     def init(
-        cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+        cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
+        mesh=None,
     ) -> "PagedKVPool":
+        """``mesh`` (a serving TP mesh): commit the pool KV-head-sharded
+        over the 'tensor' axis — each shard holds every page of its own
+        Hkv/tp heads (POOL_SPEC_AXES), which is what keeps the serving
+        programs' block-table gathers collective-free."""
         assert num_pages >= 1 and page_size >= 1, (num_pages, page_size)
         shape = (cfg.n_layer, num_pages, cfg.kv_heads, cfg.head_dim, page_size)
-        return PagedKVPool(
-            k=jnp.zeros(shape, dtype),
-            v=jnp.zeros(shape, dtype),
-            page_size=page_size,
-        )
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from midgpt_tpu.parallel.sharding import (
+                DEFAULT_LOGICAL_RULES,
+            )
+
+            spec = P(*[
+                DEFAULT_LOGICAL_RULES.get(a) if a is not None else None
+                for a in POOL_SPEC_AXES
+            ])
+            sharding = NamedSharding(mesh, spec)
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        return PagedKVPool(k=k, v=v, page_size=page_size)
 
     @property
     def num_pages(self) -> int:
@@ -377,14 +403,19 @@ def flush_recent(
     # arrive [S*K, L, Hkv, C]
     vals_k = jnp.transpose(rk, (1, 3, 0, 2, 4)).reshape(s * kk, l, hkv, c)
     vals_v = jnp.transpose(rv, (1, 3, 0, 2, 4)).reshape(s * kk, l, hkv, c)
+    # TP: rows scatter per shard into its own heads' pages (the head dim
+    # is untouched by the scatter indices); pin values + result so the
+    # donated pool's sharding survives the window (no-op without a mesh)
+    vals_k = shard_act(vals_k, None, None, "kv_heads", None)
+    vals_v = shard_act(vals_v, None, None, "kv_heads", None)
     pg, of = page.reshape(-1), off.reshape(-1)
     return PagedKVPool(
-        k=pool.k.at[:, pg, :, :, of].set(
+        k=shard_act(pool.k.at[:, pg, :, :, of].set(
             vals_k.astype(pool.k.dtype), mode="drop"
-        ),
-        v=pool.v.at[:, pg, :, :, of].set(
+        ), *POOL_SPEC_AXES),
+        v=shard_act(pool.v.at[:, pg, :, :, of].set(
             vals_v.astype(pool.v.dtype), mode="drop"
-        ),
+        ), *POOL_SPEC_AXES),
         page_size=ps,
     )
 
@@ -412,12 +443,12 @@ def write_prompt_pages(
         return jnp.transpose(a, (0, 3, 1, 2, 4))  # [L, n, Hkv, C, PS]
 
     return PagedKVPool(
-        k=pool.k.at[:, page_rows].set(
+        k=shard_act(pool.k.at[:, page_rows].set(
             to_pages(ks).astype(pool.k.dtype), mode="drop"
-        ),
-        v=pool.v.at[:, page_rows].set(
+        ), *POOL_SPEC_AXES),
+        v=shard_act(pool.v.at[:, page_rows].set(
             to_pages(vs).astype(pool.v.dtype), mode="drop"
-        ),
+        ), *POOL_SPEC_AXES),
         page_size=ps,
     )
 
@@ -446,15 +477,17 @@ def write_token_rows(
     off = pos % ps
     # advanced indices at axes 1 and 4 are non-adjacent: the broadcast
     # [T] index dim moves to the FRONT — vals arrive [T, L, Hkv, C]
-    vals_k = jnp.transpose(ks, (2, 0, 1, 3))
-    vals_v = jnp.transpose(vs, (2, 0, 1, 3))
+    vals_k = shard_act(jnp.transpose(ks, (2, 0, 1, 3)), None, None,
+                       "kv_heads", None)
+    vals_v = shard_act(jnp.transpose(vs, (2, 0, 1, 3)), None, None,
+                       "kv_heads", None)
     return PagedKVPool(
-        k=pool.k.at[:, page, :, :, off].set(
+        k=shard_act(pool.k.at[:, page, :, :, off].set(
             vals_k.astype(pool.k.dtype), mode="drop"
-        ),
-        v=pool.v.at[:, page, :, :, off].set(
+        ), *POOL_SPEC_AXES),
+        v=shard_act(pool.v.at[:, page, :, :, off].set(
             vals_v.astype(pool.v.dtype), mode="drop"
-        ),
+        ), *POOL_SPEC_AXES),
         page_size=ps,
     )
 
@@ -465,6 +498,13 @@ def copy_page(pool: PagedKVPool, src: Array, dst: Array) -> PagedKVPool:
     copy it may append into, leaving the shared original untouched. One
     dynamic slice + update per pool array; donate the pool when jitting
     (the engine's compiled wrapper does)."""
+    # no shard_act pins here: the engine jits copy_page OUTSIDE any
+    # axis_rules scope (one mesh-free wrapper shared by every engine),
+    # where shard_act is a no-op by construction. Sharding under TP
+    # rides GSPMD propagation instead, which is airtight for this op:
+    # both slice and update index the replicated page dim, so the
+    # result carries the committed input pool's sharding — and the
+    # donated buffer aliases because nothing reshards.
     k_row = jax.lax.dynamic_slice_in_dim(pool.k, src, 1, axis=1)
     v_row = jax.lax.dynamic_slice_in_dim(pool.v, src, 1, axis=1)
     return PagedKVPool(

@@ -103,6 +103,17 @@ def main() -> None:
         "only — requires --temperature 0). Default off.",
     )
     ap.add_argument(
+        "--serve_tp", type=_positive_int, default=None,
+        help="tensor-parallel degree in --serve mode: restore + serve on "
+        "a tensor-only mesh over the first N devices (column/row-"
+        "parallel weights, KV pool sharded by whole KV heads, vocab-"
+        "sharded logits). 1 forces the single-chip engine on a "
+        "multi-chip host. Default: the config mesh itself when it is "
+        "serving-compatible (no sequence/pipeline axes — fsdp/replica "
+        "restore sharding is preserved), else a tensor-only mesh at "
+        "the config's tensor degree.",
+    )
+    ap.add_argument(
         "--no_prefix_cache", action="store_true",
         help="disable prefix-cache page sharing in --serve mode",
     )
@@ -169,27 +180,63 @@ def main() -> None:
         else jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     )
 
-    # multi-chip: restore straight into the config's mesh shardings and
-    # decode distributed (the reference replicates fully, sample.py:177-182).
-    # The rules match quantized leaves too (same `.../weight` paths; the
-    # tiny per-channel scale vectors stay replicated)
+    # multi-chip: restore straight into mesh shardings and decode
+    # distributed (the reference replicates fully, sample.py:177-182).
+    # --serve --serve_tp N picks a tensor-only SERVING mesh over the
+    # first N devices (the geometry ServingEngine shards its KV pool
+    # and programs on); otherwise the config's training mesh is used as
+    # before. The rules match quantized leaves too (same `.../weight`
+    # paths, plus the explicit `.../scale` rules splitting each
+    # per-channel scale vector with its weight's out dim)
     mesh = None
-    if jax.device_count() > 1:
-        from midgpt_tpu.models.gpt import GPT_PARAM_RULES
+    if args.serve:
+        from midgpt_tpu.serving import serving_meshes
+
+        if args.serve_tp:
+            # explicit TP degree: tensor-only mesh over the first N
+            # devices (None when N == 1 — the single-chip engine)
+            mesh = serving_meshes(tp_size=args.serve_tp)[0]
+        elif jax.device_count() > 1:
+            # default: the config mesh itself WHEN the engine can serve
+            # on it (no sequence/pipeline axes — fsdp/replica restore
+            # sharding is preserved, the engine tolerates those axes as
+            # replicated/contraction-sharded); a training config with
+            # sequence/pipeline parallelism falls back to a tensor-only
+            # mesh at its tensor degree (there is nothing to
+            # sequence-shard one decode token deep)
+            from midgpt_tpu.parallel.mesh import create_mesh
+
+            try:
+                mesh = create_mesh(cfg.mesh)
+            except (AssertionError, ValueError):
+                mesh = None
+            if mesh is not None and (
+                mesh.shape.get("sequence", 1) > 1
+                or mesh.shape.get("pipeline", 1) > 1
+            ):
+                tp_deg = (
+                    cfg.mesh.tensor
+                    if 1 <= cfg.mesh.tensor <= jax.device_count()
+                    else 1
+                )
+                mesh = serving_meshes(tp_size=tp_deg)[0]
+    elif jax.device_count() > 1:
         from midgpt_tpu.parallel.mesh import create_mesh
-        from midgpt_tpu.parallel.sharding import param_shardings
 
         try:
             mesh = create_mesh(cfg.mesh)
         except (AssertionError, ValueError):
             mesh = None  # config mesh doesn't fit this host's devices
-        if mesh is not None:
-            shardings = param_shardings(mesh, abstract_params, GPT_PARAM_RULES)
-            abstract_params = jax.tree.map(
-                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
-                abstract_params,
-                shardings,
-            )
+    if mesh is not None:
+        from midgpt_tpu.models.gpt import GPT_PARAM_RULES
+        from midgpt_tpu.parallel.sharding import param_shardings
+
+        shardings = param_shardings(mesh, abstract_params, GPT_PARAM_RULES)
+        abstract_params = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract_params,
+            shardings,
+        )
 
     items, meta = ckpt.restore({item: abstract_params})
     model = items[item]

@@ -101,6 +101,19 @@ def main() -> None:
                     "matmul, halving the per-token weight HBM stream — "
                     "visible as both serve_tok_s (latency) and "
                     "serve_peak_hbm_bytes (memory)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree per engine replica: "
+                    "weights column/row-parallel, KV pool sharded by "
+                    "whole KV heads, vocab-sharded logits — the "
+                    "per-chip weight/KV stream drops to 1/tp at the "
+                    "cost of 2 activation-row psums per layer (PERF.md "
+                    "arithmetic); needs tp*dp_replicas devices")
+    ap.add_argument("--dp_replicas", type=int, default=1,
+                    help="shared-nothing data-parallel engine replicas "
+                    "under least-loaded admission "
+                    "(midgpt_tpu.serving.ServingCluster); each replica "
+                    "owns tp devices, its own page pool and prefix "
+                    "cache — throughput scales, nothing is shared")
     ap.add_argument("--out", default=None,
                     help="output JSON path (default "
                     "artifacts/bench_serving.json; the r6 queue's K-ladder "
@@ -180,8 +193,9 @@ def main() -> None:
             for i, p in enumerate(prompts)
         ]
 
-    eng = ServingEngine(
-        model,
+    from midgpt_tpu.serving import ServingCluster, serving_meshes
+
+    engine_kw = dict(
         slots=args.slots,
         page_size=args.page_size,
         window=args.window,
@@ -191,29 +205,44 @@ def main() -> None:
         prefill_chunk=args.prefill_chunk or None,
         speculate=args.spec_len if args.spec == "on" else 0,
     )
+    meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
+    if args.dp_replicas > 1:
+        eng = ServingCluster(model, meshes=meshes, **engine_kw)
+        engines = eng.engines
+    else:
+        eng = ServingEngine(model, mesh=meshes[0], **engine_kw)
+        engines = [eng]
 
     # warmup: compile the decode window + EVERY prefill-chunk bucket the
-    # trace can dispatch. Full-prompt buckets are not enough: with the
-    # prefix cache on, admissions prefill arbitrary suffix lengths (and
-    # chunking caps them at prefill_chunk), so the cache-on/chunked
-    # ladder rungs would otherwise pay XLA compiles inside the timed
-    # region — corrupting exactly the comparison they exist for.
-    eng.submit(prompts[0], int(nnews[0]))
-    eng.run()
-    eng.warm_prefill(max(p.size for p in prompts))
-    eng.finished.clear()
-    eng.clear_prefix_cache()  # measured hit rates come from the trace alone
-    for attr in ("decode_dispatches", "prefill_dispatches",
-                 "copy_dispatches", "tokens_generated", "windows",
-                 "occupancy_sum", "evictions", "prompt_tokens_total",
-                 "prompt_tokens_cached", "prefill_tokens_computed",
-                 "cold_reclaims", "verify_dispatches", "spec_drafted",
-                 "spec_accepted"):
-        setattr(eng, attr, 0)
+    # trace can dispatch, on EVERY replica. Full-prompt buckets are not
+    # enough: with the prefix cache on, admissions prefill arbitrary
+    # suffix lengths (and chunking caps them at prefill_chunk), so the
+    # cache-on/chunked ladder rungs would otherwise pay XLA compiles
+    # inside the timed region — corrupting exactly the comparison they
+    # exist for. (DP replicas share program wrappers only when pinned to
+    # identical devices — they are not — so each warms its own.)
+    for e in engines:
+        e.submit(prompts[0], int(nnews[0]))
+        e.run()
+        e.warm_prefill(max(p.size for p in prompts))
+        e.finished.clear()
+        e.clear_prefix_cache()  # measured hit rates: the trace alone
+        for attr in ("decode_dispatches", "prefill_dispatches",
+                     "copy_dispatches", "tokens_generated", "windows",
+                     "occupancy_sum", "evictions", "prompt_tokens_total",
+                     "prompt_tokens_cached", "prefill_tokens_computed",
+                     "cold_reclaims", "verify_dispatches", "spec_drafted",
+                     "spec_accepted"):
+            setattr(e, attr, 0)
+    if args.dp_replicas > 1:
+        eng.finished.clear()
+        eng._route.clear()
 
     t0 = time.monotonic()
     submitted = 0
-    while submitted < args.requests or eng.queue or eng._active_slots():
+    while submitted < args.requests or any(
+        e.queue or e._active_slots() for e in engines
+    ):
         now = time.monotonic() - t0
         while submitted < args.requests and arrivals[submitted] <= now:
             eng.submit(
@@ -234,6 +263,43 @@ def main() -> None:
     mem = jax.devices()[0].memory_stats() or {}
     peak_hbm = mem.get("peak_bytes_in_use")
 
+    # per-axis comms summary of the sharded decode window (analysis/cost):
+    # compile the SAME program geometry (full size, same mesh shape)
+    # through the audit harness and attribute each collective's wire
+    # bytes to its mesh axis — the static per-dispatch number PERF.md's
+    # comms arithmetic is stated against (2 activation psums/layer + the
+    # argmax combiner under TP). Cost honesty: this is a second AOT
+    # compile of the window (jax's dispatch-path executable cache does
+    # not serve .lower().compile()) plus a transient second model/pool
+    # on device — it runs AFTER the timed region and the peak-HBM read,
+    # so it can only cost queue wall-clock, and a failure here must not
+    # lose the bench record. tp=1 has no collectives — emit zeros.
+    comms_bytes, comms_by_axis, comms_count = 0, {}, 0
+    if args.tp > 1:
+        try:
+            from midgpt_tpu.analysis import hlo as hlo_mod
+            from midgpt_tpu.analysis.cost import cost_report
+            from midgpt_tpu.analysis.harness import compile_decode_window
+            from midgpt_tpu.analysis.rules import StepAnalysis
+
+            exp = dataclasses.replace(get_config("openwebtext"), model=cfg)
+            hlo, amesh, donated, blk, _, _ = compile_decode_window(
+                exp, slots=args.slots, window=args.window,
+                page_size=args.page_size, shrink=False,
+                quant=args.quant == "on", mesh_shape={"tensor": args.tp},
+            )
+            analysis = StepAnalysis.from_text(
+                hlo, hlo_mod.MeshInfo.from_mesh(amesh, num_slices=1),
+                global_batch=args.slots, block=blk, donated_leaves=donated,
+            )
+            rep = cost_report(analysis)
+            comms_bytes = rep["value"]
+            comms_by_axis = rep["by_axis"]
+            comms_count = rep["collective_count"]
+        except Exception as e:  # noqa: BLE001 — summary is best-effort
+            print(f"comms summary skipped: {e}", file=sys.stderr)
+            comms_bytes = None
+
     ttfts = sorted(
         (r.first_token_time - r.submit_time) * 1e3
         for r in eng.finished.values()
@@ -249,8 +315,13 @@ def main() -> None:
             f"sys={args.sys_prompt_len} "
             f"spec={args.spec_len if args.spec == 'on' else 'off'}"
             f"{' rep' if args.repetitive else ''}"
-            f" quant={args.quant}"
+            f" quant={args.quant} tp={args.tp} dp={args.dp_replicas}"
         ),
+        "serve_tp": args.tp,
+        "serve_dp_replicas": args.dp_replicas,
+        "serve_comms_bytes_per_dispatch": comms_bytes,
+        "serve_comms_by_axis": comms_by_axis,
+        "serve_comms_collective_count": comms_count,
         "serve_quant": args.quant,
         "serve_peak_hbm_bytes": peak_hbm,
         "serve_requests": args.requests,

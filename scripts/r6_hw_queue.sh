@@ -96,6 +96,18 @@ run serving_spec_on python scripts/bench_serving.py --platform=tpu \
 # artifacts/bench_serving.json (the default-run rung above).
 run serving_quant python scripts/bench_serving.py --platform=tpu \
   --quant on --out artifacts/bench_serving_quant.json
+# TPxDP sharded serving (PR 7): the same trace on a tp=4 engine (model
+# weights + KV pool split over 4 chips — the SNIPPETS.md target
+# geometry; serve_comms_by_axis records the per-dispatch psum bytes the
+# PERF.md arithmetic predicts) and on 2 shared-nothing tp=2 replicas
+# under least-loaded admission (throughput axis). Skips cleanly (rc!=0
+# in queue.log) on hosts with fewer than 4 chips.
+run serving_tp4 python scripts/bench_serving.py --platform=tpu \
+  --tp 4 --out artifacts/bench_serving_tp4.json
+run serving_tp2_dp2 python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --dp_replicas 2 --out artifacts/bench_serving_tp2_dp2.json
+run serving_tp4_quant python scripts/bench_serving.py --platform=tpu \
+  --tp 4 --quant on --out artifacts/bench_serving_tp4_quant.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);
