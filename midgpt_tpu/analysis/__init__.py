@@ -5,13 +5,15 @@ lint for TPU footguns.
 Layering:
 
 - :mod:`~midgpt_tpu.analysis.hlo`, :mod:`~midgpt_tpu.analysis.rules`,
-  :mod:`~midgpt_tpu.analysis.cost`, :mod:`~midgpt_tpu.analysis.pylint_pass`
-  are jax-free (pure text/AST processing) — importable anywhere, unit-
-  testable in milliseconds against canned fixtures.
+  :mod:`~midgpt_tpu.analysis.cost`, :mod:`~midgpt_tpu.analysis.pylint_pass`,
+  :mod:`~midgpt_tpu.analysis.traffic`, :mod:`~midgpt_tpu.analysis.budgets`
+  are jax-free (pure text/AST/arithmetic processing) — importable
+  anywhere, unit-testable in milliseconds against canned fixtures.
 - :mod:`~midgpt_tpu.analysis.harness` imports jax and compiles the real
-  train step; its names are re-exported lazily so ``import
-  midgpt_tpu.analysis`` stays light (the CLI must configure the platform
-  *before* jax loads).
+  train step; :mod:`~midgpt_tpu.analysis.choreo` imports jax and traces
+  the serving programs to jaxprs. Their names are re-exported lazily so
+  ``import midgpt_tpu.analysis`` stays light (the CLI must configure
+  the platform *before* jax loads).
 
 CLI: ``python -m midgpt_tpu.analysis --config <name> --mesh 8`` — see the
 README's "Static sharding analysis" section.
@@ -28,7 +30,15 @@ from midgpt_tpu.analysis.hlo import (
     parse_input_output_alias,
     parse_replica_groups,
 )
+from midgpt_tpu.analysis.budgets import budget_for, check_budget
 from midgpt_tpu.analysis.pylint_pass import Finding, lint_paths, lint_source
+from midgpt_tpu.analysis.traffic import (
+    TrafficReport,
+    floor_decomposition,
+    floor_table_markdown,
+    traffic_report,
+    weight_stream_bytes,
+)
 from midgpt_tpu.analysis.rules import (
     Report,
     Rule,
@@ -44,6 +54,7 @@ _HARNESS_NAMES = (
     "compile_eval_sweep",
     "compile_train_step",
     "override_logical_rules",
+    "prove_serving_choreography",
     "shrink_for_audit",
     "train_step_comms_summary",
 )
@@ -57,8 +68,15 @@ __all__ = [
     "Rule",
     "RuleSet",
     "StepAnalysis",
+    "TrafficReport",
     "Violation",
+    "budget_for",
+    "check_budget",
     "cost_report",
+    "floor_decomposition",
+    "floor_table_markdown",
+    "traffic_report",
+    "weight_stream_bytes",
     "count_entry_parameters",
     "dtypes_used",
     "lint_paths",

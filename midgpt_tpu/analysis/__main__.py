@@ -115,6 +115,41 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 4)",
     )
     p.add_argument(
+        "--choreo", action="store_true",
+        help="with --serving: run the arithmetic-choreography prover "
+        "(analysis.choreo) over the three serving programs, bf16 AND "
+        "int8 — trace each program to a jaxpr, normalize the attention "
+        "/lm-head subgraphs into op-and-dtype traces, and prove verify "
+        "mirrors decode op-for-op, the prefill chunk mirrors "
+        "naive_attention's softmax core, and the shared arithmetic "
+        "(f32 softmax/accumulation, mask-before-scale, one lm-head "
+        "choreography) holds everywhere. The machine check for the "
+        "PR 4/PR 5 bf16 argmax-flip bug class.",
+    )
+    p.add_argument(
+        "--traffic", action="store_true",
+        help="with --serving: compute each compiled program's static "
+        "HBM streams (weight/KV/logits/control entry parameters + "
+        "baked-in constants + collective wire bytes, analysis.traffic) "
+        "and gate them against the checked-in byte budgets "
+        "(analysis.budgets) when the audit geometry matches. The "
+        "accounting generalization of no-dequant-materialization: any "
+        "regression that re-materializes, re-gathers or constant-folds "
+        "a large buffer moves bytes and trips the gate.",
+    )
+    p.add_argument(
+        "--print-budgets", action="store_true",
+        help="with --serving --traffic: print the measured streams as "
+        "a ready-to-paste analysis/budgets.py BUDGETS fragment "
+        "(regeneration path after an intentional geometry change)",
+    )
+    p.add_argument(
+        "--precision", choices=("bf16", "int8", "both"), default="both",
+        help="which weight paths the serving audits compile (default "
+        "both; the CI matrix runs one per job so a quant failure "
+        "cannot mask a bf16 one)",
+    )
+    p.add_argument(
         "--mesh-shape", default=None, metavar="SPEC",
         help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
         "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
@@ -171,6 +206,223 @@ def _ensure_devices(platform: str, n: int) -> None:
         )
 
 
+def _precisions(args) -> tp.Tuple[str, ...]:
+    return {
+        "bf16": ("bf16",), "int8": ("int8",), "both": ("bf16", "int8"),
+    }[args.precision]
+
+
+def _run_choreo(args, cfg):
+    """Run the choreography prover for the selected precisions; returns
+    ``(per_precision_dicts, ok, violation_strings)`` — shared by the
+    standalone ``--choreo`` mode and the ``--serving --choreo`` path."""
+    from midgpt_tpu.analysis.harness import prove_serving_choreography
+
+    out: tp.Dict[str, tp.Any] = {}
+    ok = True
+    violations: tp.List[str] = []
+    for precision in _precisions(args):
+        rep = prove_serving_choreography(cfg, quant=(precision == "int8"))
+        out[precision] = rep.to_dict()
+        ok = ok and rep.ok
+        violations.extend(
+            f"[choreo/{precision}] {c.name}: {c.detail}"
+            for c in rep.checks
+            if not c.ok
+        )
+    return out, ok, violations
+
+
+def _run_choreo_only(args, cfg) -> int:
+    sections, ok, violations = _run_choreo(args, cfg)
+    out: tp.Dict[str, tp.Any] = {
+        "config": args.config, "mode": "serving-choreography",
+        **sections, "ok": ok,
+    }
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    for v in violations:
+        print(f"VIOLATION {v}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _run_serving(args, cfg, mesh_shape) -> int:
+    """The --serving audits: compile the engine's three hot-path
+    programs (decode window / prefill chunk / speculative verify) on
+    one shared geometry per selected precision, evaluate the serving
+    ruleset on each, and optionally (a) gate the static HBM streams
+    against the checked-in byte budgets (--traffic) and (b) run the
+    arithmetic-choreography prover (--choreo)."""
+    from midgpt_tpu.analysis.harness import (
+        audit_decode_window,
+        audit_prefill_chunk,
+        audit_verify_program,
+    )
+
+    k = args.steps_per_dispatch or 4
+    precisions = _precisions(args)
+    # the chunked-prefill steady state interleaves a prefill chunk
+    # between decode windows, and with speculation on every decode
+    # dispatch IS a verify dispatch: all three programs are audited on
+    # one shared geometry (_serving_audit_setup) per precision — the
+    # int8 leg additionally gates no-dequant-materialization (s8 entry
+    # params, dequant fused into each matmul)
+    program_specs = (
+        ("decode_window", audit_decode_window, dict(
+            slots=args.serving_slots, window=k,
+            page_size=args.serving_page_size,
+        ), k),
+        ("prefill_chunk", audit_prefill_chunk, dict(
+            page_size=args.serving_page_size,
+        ), 1),
+        ("verify_program", audit_verify_program, dict(
+            slots=args.serving_slots, spec_len=args.serving_spec_len,
+            page_size=args.serving_page_size,
+        ), 1),
+    )
+
+    # --traffic budget gating applies only at the geometry the budgets
+    # were measured at (analysis/budgets.AUDIT_GEOMETRY)
+    budget_geom = None
+    if args.traffic:
+        from midgpt_tpu.analysis.budgets import (
+            AUDIT_GEOMETRY,
+            geometry_key,
+        )
+
+        matches = (
+            args.config == AUDIT_GEOMETRY["config"]
+            and not args.no_shrink
+            and args.serving_slots == AUDIT_GEOMETRY["slots"]
+            and k == AUDIT_GEOMETRY["window"]
+            and args.serving_page_size == AUDIT_GEOMETRY["page_size"]
+            and args.serving_spec_len == AUDIT_GEOMETRY["spec_len"]
+        )
+        budget_geom = geometry_key(mesh_shape) if matches else None
+
+    ok = True
+    violations: tp.List[str] = []
+    sections: tp.Dict[str, tp.Any] = {}
+    budget_fragment: tp.Dict[tp.Tuple[str, str], tp.Any] = {}
+    for precision in precisions:
+        for name, fn, kw, steps in program_specs:
+            res = fn(
+                cfg, shrink=not args.no_shrink,
+                quant=(precision == "int8"), mesh_shape=mesh_shape,
+                traffic=args.traffic, **kw
+            )
+            analysis, report = res[0], res[1]
+            ok = ok and report.ok
+            violations.extend(str(v) for v in report.violations)
+            section = {
+                "donated_leaves": analysis.donated_leaves,
+                "aliased_buffers": len(
+                    {e.param_number for e in analysis.aliases}
+                ),
+                "rules": report.to_dict()["rules"],
+            }
+            if args.traffic:
+                from midgpt_tpu.analysis.budgets import (
+                    budget_for,
+                    check_budget,
+                )
+
+                traf = res[2]
+                section["traffic"] = traf.to_dict()
+                budget_fragment[(name, precision)] = traf
+                budget = (
+                    budget_for(name, precision, budget_geom)
+                    if budget_geom
+                    else None
+                )
+                if budget is not None:
+                    bad = check_budget(traf, budget)
+                    section["budget"] = {
+                        "geometry": budget_geom,
+                        "ok": not bad,
+                        "violations": bad,
+                    }
+                    ok = ok and not bad
+                    violations.extend(bad)
+                else:
+                    section["budget"] = {
+                        "geometry": budget_geom,
+                        "ok": None,
+                        "violations": [],
+                    }
+            sections[f"{name}/{precision}"] = section
+
+    choreo_out = None
+    if args.choreo:
+        choreo_out, choreo_ok, choreo_violations = _run_choreo(args, cfg)
+        ok = ok and choreo_ok
+        violations.extend(choreo_violations)
+
+    out = {
+        "config": args.config,
+        "mode": "serving-audit",
+        "precisions": list(precisions),
+        "ok": ok,
+        "geometry": {
+            "slots": args.serving_slots,
+            "steps_per_dispatch": k,
+            "page_size": args.serving_page_size,
+            "spec_len": args.serving_spec_len,
+            "mesh_shape": mesh_shape,
+        },
+        "programs": sections,
+    }
+    if choreo_out is not None:
+        out["choreography"] = choreo_out
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    if args.print_budgets and args.traffic:
+        # the fragment must carry EVERY gate key: a pasted budget
+        # missing constants_max/comms_max would silently disable the
+        # constant-folding and regather trips check_budget keys on
+        geom = budget_geom
+        if geom is None:
+            from midgpt_tpu.analysis.budgets import geometry_key
+
+            geom = geometry_key(mesh_shape)
+            print(
+                "# WARNING: non-default audit geometry — update "
+                "AUDIT_GEOMETRY alongside the budgets",
+                file=sys.stderr,
+            )
+        print("# analysis/budgets.py fragment (measured):", file=sys.stderr)
+        for (name, precision), traf in budget_fragment.items():
+            entry = {
+                "weights": traf.streams["weights"],
+                "kv": traf.streams["kv"],
+                "logits": traf.streams["logits"],
+                # headroom over the measured baseline: constants are
+                # geometry-constant rope tables (any baked weight jumps
+                # past 3x), comms scales with the audited payloads
+                "constants_max": 3 * max(
+                    traf.streams["constants"], 4096
+                ),
+            }
+            if traf.comms_bytes:
+                entry["comms_max"] = traf.comms_bytes * 3 // 2
+            print(
+                f"    ({name!r}, {precision!r}, {geom!r}): "
+                + json.dumps(entry),
+                file=sys.stderr,
+            )
+    if not ok:
+        for v in violations:
+            print(f"VIOLATION {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -222,133 +474,11 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             return 2
 
     if args.serving:
-        from midgpt_tpu.analysis.harness import (
-            audit_decode_window,
-            audit_prefill_chunk,
-            audit_verify_program,
-        )
-
-        k = args.steps_per_dispatch or 4
-        analysis, report = audit_decode_window(
-            cfg,
-            slots=args.serving_slots,
-            window=k,
-            page_size=args.serving_page_size,
-            shrink=not args.no_shrink,
-            mesh_shape=mesh_shape,
-        )
-        # the chunked-prefill steady state interleaves a prefill chunk
-        # between decode windows (its block table may alias pages shared
-        # copy-on-write with other slots): audit that program too
-        chunk_analysis, chunk_report = audit_prefill_chunk(
-            cfg,
-            page_size=args.serving_page_size,
-            shrink=not args.no_shrink,
-            mesh_shape=mesh_shape,
-        )
-        # with speculation on every decode dispatch IS a verify dispatch:
-        # audit the verify program on the same geometry as the other two
-        # (_serving_audit_setup is shared by all three compiles)
-        spec_analysis, spec_report = audit_verify_program(
-            cfg,
-            slots=args.serving_slots,
-            spec_len=args.serving_spec_len,
-            page_size=args.serving_page_size,
-            shrink=not args.no_shrink,
-            mesh_shape=mesh_shape,
-        )
-        # the int8 quantized weight path compiles all three programs
-        # again from the SAME _serving_audit_setup geometry and adds the
-        # no-dequant-materialization rule: the int8 arrays must enter as
-        # s8 parameters with the dequant fused into each matmul — a
-        # closed-over or pre-dequantized model silently streams (or
-        # constant-folds to) full-precision weights, undoing the halved
-        # weight stream the quant path pays for
-        quant_reports = {}
-        quant_ok = True
-        for qname, qfn, qkw in (
-            ("decode_window", audit_decode_window, dict(
-                slots=args.serving_slots, window=k,
-                page_size=args.serving_page_size,
-            )),
-            ("prefill_chunk", audit_prefill_chunk, dict(
-                page_size=args.serving_page_size,
-            )),
-            ("verify_program", audit_verify_program, dict(
-                slots=args.serving_slots,
-                spec_len=args.serving_spec_len,
-                page_size=args.serving_page_size,
-            )),
-        ):
-            q_analysis, q_report = qfn(
-                cfg, shrink=not args.no_shrink, quant=True,
-                mesh_shape=mesh_shape, **qkw
-            )
-            quant_ok = quant_ok and q_report.ok
-            quant_reports[qname] = (q_analysis, q_report)
-        ok = report.ok and chunk_report.ok and spec_report.ok and quant_ok
-        out = {
-            "config": args.config,
-            "mode": "serving-decode-window+prefill-chunk+verify-program"
-            "+quantized",
-            "ok": ok,
-            "geometry": {
-                "slots": args.serving_slots,
-                "steps_per_dispatch": k,
-                "page_size": args.serving_page_size,
-                "spec_len": args.serving_spec_len,
-                "mesh_shape": mesh_shape,
-                "donated_leaves": analysis.donated_leaves,
-                "aliased_buffers": len(
-                    {e.param_number for e in analysis.aliases}
-                ),
-            },
-            "rules": report.to_dict()["rules"],
-            "prefill_chunk": {
-                "donated_leaves": chunk_analysis.donated_leaves,
-                "aliased_buffers": len(
-                    {e.param_number for e in chunk_analysis.aliases}
-                ),
-                "rules": chunk_report.to_dict()["rules"],
-            },
-            "verify_program": {
-                "donated_leaves": spec_analysis.donated_leaves,
-                "aliased_buffers": len(
-                    {e.param_number for e in spec_analysis.aliases}
-                ),
-                "rules": spec_report.to_dict()["rules"],
-            },
-            "quantized": {
-                qname: {
-                    "donated_leaves": qa.donated_leaves,
-                    "aliased_buffers": len(
-                        {e.param_number for e in qa.aliases}
-                    ),
-                    "rules": qr.to_dict()["rules"],
-                }
-                for qname, (qa, qr) in quant_reports.items()
-            },
-        }
-        text = json.dumps(out, indent=2)
-        print(text)
-        if args.json:
-            with open(args.json, "w") as f:
-                f.write(text + "\n")
-        if not ok:
-            violations = (
-                report.violations
-                + chunk_report.violations
-                + spec_report.violations
-                + tuple(
-                    v
-                    for _, qr in quant_reports.values()
-                    for v in qr.violations
-                )
-            )
-            for v in violations:
-                print(f"VIOLATION {v}", file=sys.stderr)
-            return 1
-        return 0
+        return _run_serving(args, cfg, mesh_shape)
+    if args.choreo:
+        # standalone prover: no compilation, jaxpr tracing only — the
+        # fast CI gate (--serving --choreo runs it next to the audits)
+        return _run_choreo_only(args, cfg)
 
     overrides = dict(args.override_logical_rule) or None
     if overrides:

@@ -1,0 +1,188 @@
+"""Checked-in HBM byte budgets for the serving programs.
+
+The numbers a serving program is ALLOWED to stream, per dispatch, at
+the CI audit geometry — the generalization of the shape-matching
+``no-dequant-materialization`` / ``no-batch-allgather-in-page-gather``
+rules into plain accounting: any regression that re-materializes,
+re-gathers, or constant-folds a large buffer moves bytes, and a moved
+byte count trips the gate regardless of what the HLO happens to look
+like. Concretely:
+
+- a model CLOSED OVER by a program (the PR 6 bug) removes the weight
+  stream from the entry interface (below the weights band) and dumps it
+  into ``constants`` (above the constants cap) — two trips, with the
+  quantized variant additionally 4x over on the folded f32 copies;
+- a KV-head-sharded pool regathered through the page gathers (the PR 7
+  bug class) multiplies the sharded geometry's ``comms`` bytes past its
+  cap;
+- an accidental full-precision weight copy smuggled in as a second
+  input lands in ``unclassified`` (its own violation).
+
+Budgets are exact measured values with a relative tolerance band, keyed
+by ``(program, precision, geometry)`` at the ONE audit geometry CI
+compiles (:data:`AUDIT_GEOMETRY`): openwebtext shrunk to 2 layers /
+block 256 / vocab 1024, slots=4, window=4, page_size=16, spec_len=4.
+Regenerate after an intentional geometry or model change with::
+
+    python -m midgpt_tpu.analysis --config openwebtext --serving \
+        --traffic --print-budgets
+
+and paste the emitted dict here — the diff IS the review artifact.
+
+jax-free (pure numbers), like rules.py.
+"""
+
+from __future__ import annotations
+
+import typing as tp
+
+# the geometry every budget below was measured at; the CLI refuses to
+# gate traffic on a non-matching geometry rather than mis-fail it
+AUDIT_GEOMETRY: tp.Dict[str, tp.Any] = {
+    "config": "openwebtext",
+    "n_layer": 2,
+    "block_size": 256,
+    "vocab_size": 1024,
+    "slots": 4,
+    "window": 4,
+    "page_size": 16,
+    "spec_len": 4,
+}
+
+# streams are bytes at the compiled program's entry interface
+# (traffic.traffic_report); comms is the per-dispatch collective wire
+# estimate (cost.py's ring-algorithm arithmetic) on sharded geometries
+BUDGETS: tp.Dict[tp.Tuple[str, str, str], tp.Dict[str, int]] = {
+    # --- single chip, bf16 ---
+    ("decode_window", "bf16", "single"): {
+        "weights": 31457792, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    ("prefill_chunk", "bf16", "single"): {
+        "weights": 31457792, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    ("verify_program", "bf16", "single"): {
+        "weights": 31457792, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    # --- single chip, int8 (s8 matrices + f32 per-channel scales) ---
+    ("decode_window", "int8", "single"): {
+        "weights": 16574976, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    ("prefill_chunk", "int8", "single"): {
+        "weights": 16574976, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    ("verify_program", "int8", "single"): {
+        "weights": 16574976, "kv": 6291456, "logits": 16384,
+        "constants_max": 262144,
+    },
+    # --- tp=2,replica=2 (per-shard local streams: weights and the
+    # whole-KV-head pool halve; replica rides replicated) ---
+    ("decode_window", "bf16", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 165936,
+    },
+    ("prefill_chunk", "bf16", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 2654208,
+    },
+    ("verify_program", "bf16", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 829728,
+    },
+    ("decode_window", "int8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 165936,
+    },
+    ("prefill_chunk", "int8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 2654208,
+    },
+    ("verify_program", "int8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 3145728, "logits": 8192,
+        "constants_max": 262144, "comms_max": 829728,
+    },
+}
+
+# band half-width for the exact streams: wide enough for layout/padding
+# noise across jax/XLA versions, narrow enough that the cheapest real
+# regression (one duplicated weight matrix: the [256, 1024] head, +5%
+# of the weight stream at this geometry) cannot hide inside it
+TOLERANCE = 0.04
+
+
+def geometry_key(
+    mesh_shape: tp.Optional[tp.Mapping[str, int]]
+) -> str:
+    """``None`` -> 'single'; ``{"tensor": 2, "replica": 2}`` ->
+    'replica2,tensor2' (sorted, size-1 axes dropped)."""
+    if not mesh_shape:
+        return "single"
+    parts = [
+        f"{name}{size}"
+        for name, size in sorted(mesh_shape.items())
+        if size > 1
+    ]
+    return ",".join(parts) if parts else "single"
+
+
+def budget_for(
+    program: str, precision: str, geometry: str
+) -> tp.Optional[tp.Dict[str, int]]:
+    return BUDGETS.get((program, precision, geometry))
+
+
+def check_budget(
+    report,  # traffic.TrafficReport
+    budget: tp.Mapping[str, int],
+    *,
+    tolerance: float = TOLERANCE,
+) -> tp.List[str]:
+    """Evaluate one program's measured streams against its budget;
+    returns violation strings (empty = pass). The exact streams are a
+    BAND, not a cap — bytes leaving a stream are as much a regression
+    as bytes joining one (a weight stream at 0 means the weights moved
+    into the executable, not that serving got free)."""
+    out: tp.List[str] = []
+    for stream in ("weights", "kv", "logits"):
+        expect = budget.get(stream)
+        if expect is None:
+            continue
+        got = report.streams.get(stream, 0)
+        lo = int(expect * (1 - tolerance))
+        hi = int(expect * (1 + tolerance))
+        if not (lo <= got <= hi):
+            out.append(
+                f"{report.program}: {stream} stream {got:,} B outside "
+                f"budget [{lo:,}, {hi:,}] (expected ~{expect:,})"
+            )
+    cmax = budget.get("constants_max")
+    if cmax is not None and report.streams.get("constants", 0) > cmax:
+        out.append(
+            f"{report.program}: {report.streams['constants']:,} B of "
+            f"large constants baked into the executable (cap {cmax:,}) "
+            "— model state is being constant-folded instead of streamed "
+            "as entry parameters (the PR 6 closed-over-model bug class)"
+        )
+    comms_max = budget.get("comms_max")
+    if comms_max is not None and report.comms_bytes > comms_max:
+        out.append(
+            f"{report.program}: {report.comms_bytes:,} B of collective "
+            f"wire traffic per dispatch (cap {comms_max:,}) — a sharded "
+            "buffer is being regathered (the page-gather all-gather "
+            "bug class)"
+        )
+    if report.unclassified:
+        shapes = ", ".join(
+            f"{d}[{','.join(map(str, s))}]"
+            for d, s in report.unclassified
+        )
+        out.append(
+            f"{report.program}: unclassified large float entry "
+            f"parameter(s): {shapes} — an unexplained stream joined "
+            "the program interface"
+        )
+    return out

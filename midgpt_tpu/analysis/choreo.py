@@ -1,0 +1,701 @@
+"""Arithmetic-choreography prover for the serving programs.
+
+The two most expensive serving bugs this repo has shipped were DTYPE
+CHOREOGRAPHY drift between attention paths that must agree at greedy-
+argmax granularity:
+
+- PR 4: a chunk-prefill variant that upcast the pool K/V to f32 before
+  the score einsum (and kept f32 probs through the PV contraction)
+  drifted ~2 bf16 ulps from the fixed-batch sampler and flipped
+  near-tied greedy argmaxes on a real checkpoint;
+- PR 5: the first cut of the speculative VERIFY program reused the
+  prefill choreography instead of the decode window's, flipping
+  near-tied acceptance argmaxes the same way.
+
+Both were only caught by the ``sample.py --serve`` hardware drive. The
+contracts live as prose in ``models/gpt.py`` docstrings ("the dtype
+choreography deliberately MIRRORS decode_paged_at op for op"); this
+module turns them into a machine check: trace each serving program to a
+jaxpr, slice out the per-layer attention subgraph and the lm-head
+projection, normalize them into an op-and-dtype trace (primitive,
+operand dtypes, cast positions, accumulation dtype, softmax arithmetic
+order — shapes deliberately dropped, the programs differ in T), and
+assert:
+
+1. ``decode == verify`` — the decode window and the verify program
+   produce IDENTICAL normalized attention traces, op for op (the PR 5
+   contract: acceptance must reproduce the decode path's argmaxes, so
+   it must share the decode path's arithmetic).
+2. ``prefill == naive`` — the prefill chunk's softmax-core signature
+   (operand dtypes at the score contract, mask-add position, scale op,
+   softmax dtype, the probs dtype entering the PV contraction) equals
+   ``ops.attention.naive_attention``'s (the PR 4 contract: with an
+   empty pool part the chunk must be bitwise what the monolithic
+   ``model.hidden`` prefill computes).
+3. shared arithmetic — all three programs agree on the invariants they
+   DO share: scores accumulate in f32, the additive mask lands before
+   the softmax scale, softmax runs in f32 with one joint exp per layer,
+   and the lm-head projection choreography (operand dtypes + quant
+   epilogue) is identical everywhere.
+
+The deliberate asymmetry between (1) and (2) is the point: decode and
+prefill legitimately differ (f32 probs through PV vs probs rounded to
+the value dtype; ``/ sqrt(c)`` vs ``* (1/sqrt(c))``), which is exactly
+why a verify program that drifts toward the prefill flavor is a bug the
+full-sequence check catches.
+
+Everything here operates on jaxprs (no compilation, no execution) — a
+full three-program proof runs in seconds on CPU. jax is imported at
+module level; the CLI imports this module only after platform setup
+(same discipline as :mod:`~midgpt_tpu.analysis.harness`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+import jax
+
+# ---------------------------------------------------------------------------
+# jaxpr flattening with origin tracking
+# ---------------------------------------------------------------------------
+
+# ops that forward their (first) operand's ORIGIN unchanged: moving or
+# re-viewing a buffer does not change what the value fundamentally is,
+# so a model weight sliced out of the stacked [L, ...] leaf and cast to
+# the compute dtype still traces back to its entry parameter
+_PASSTHRU = frozenset({
+    "slice", "squeeze", "reshape", "transpose", "broadcast_in_dim",
+    "device_put", "copy", "convert_element_type", "expand_dims",
+    "sharding_constraint",
+})
+
+# sub-jaxpr-carrying primitives the flattener recurses into; params are
+# scanned generically for ClosedJaxpr/Jaxpr values so new call prims
+# (or renamed ones across jax versions) degrade to unaligned recursion
+# instead of silently dropping a body
+_ALIGNED_CALLS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "custom_jvp_call",
+    "custom_vjp_call", "remat", "checkpoint", "scan", "while",
+})
+
+_FLOAT_DTYPES = frozenset({"bfloat16", "float16", "float32", "float64"})
+
+# the arithmetic alphabet a normalized trace keeps; everything else
+# (layout ops, comparisons, integer plumbing, scatters/gathers) is
+# movement, not arithmetic, and differs legitimately between programs
+_ARITH = frozenset({
+    "dot_general", "convert_element_type", "add", "sub", "mul", "div",
+    "exp", "exp2", "log", "reduce_max", "reduce_sum", "max", "min",
+    "neg", "rsqrt", "sqrt", "square", "integer_pow", "tanh", "erf",
+    "logistic", "pow",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """One flattened jaxpr equation, with global value ids and origins."""
+
+    idx: int
+    prim: str
+    in_dtypes: tp.Tuple[str, ...]
+    out_dtypes: tp.Tuple[str, ...]
+    in_ids: tp.Tuple[int, ...]  # -1 for literals
+    out_ids: tp.Tuple[int, ...]
+    # per-input provenance: 'invar' (traces to a program entry through
+    # pass-through ops only), 'const', 'lit', or 'var' (computed)
+    in_origins: tp.Tuple[str, ...]
+
+
+class FlatGraph:
+    """Flattened jaxpr: linear op list + producer/consumer maps."""
+
+    def __init__(self, ops: tp.List[Op]):
+        self.ops = ops
+        self.producer: tp.Dict[int, Op] = {}
+        self.consumers: tp.Dict[int, tp.List[Op]] = {}
+        for op in ops:
+            for vid in op.out_ids:
+                self.producer[vid] = op
+            for vid in op.in_ids:
+                if vid >= 0:
+                    self.consumers.setdefault(vid, []).append(op)
+
+
+def flatten_jaxpr(closed) -> FlatGraph:
+    """Flatten a (Closed)Jaxpr into a single linear op list, recursing
+    into pjit/scan/while/custom_jvp bodies (each body once — choreography
+    is per-iteration-identical by construction of a scan). Value ids are
+    global; sub-jaxpr invars inherit the caller operands' ids/origins, so
+    an entry parameter keeps its 'invar' origin through any call depth."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    ops: tp.List[Op] = []
+    next_id = [0]
+    # var -> (vid, origin)
+    env: tp.Dict[tp.Any, tp.Tuple[int, str]] = {}
+
+    def fresh(origin: str) -> tp.Tuple[int, str]:
+        vid = next_id[0]
+        next_id[0] += 1
+        return (vid, origin)
+
+    for i, v in enumerate(jaxpr.invars):
+        env[v] = fresh("invar")
+    for v in jaxpr.constvars:
+        env[v] = fresh("const")
+
+    def read(env_, atom) -> tp.Tuple[int, str]:
+        if hasattr(atom, "val"):  # Literal
+            return (-1, "lit")
+        if atom not in env_:
+            env_[atom] = fresh("var")
+        return env_[atom]
+
+    def walk(jpr, env_) -> None:
+        for eqn in jpr.eqns:
+            subs = [
+                p for p in eqn.params.values()
+                if hasattr(p, "eqns") or hasattr(p, "jaxpr")
+            ]
+            nested = [getattr(s, "jaxpr", s) for s in subs]
+            if nested:
+                aligned = (
+                    eqn.primitive.name in _ALIGNED_CALLS
+                    and len(nested) == 1
+                    and len(nested[0].invars) == len(eqn.invars)
+                )
+                for sub in nested:
+                    senv: tp.Dict[tp.Any, tp.Tuple[int, str]] = {}
+                    if aligned:
+                        for iv, oa in zip(sub.invars, eqn.invars):
+                            senv[iv] = read(env_, oa)
+                    else:
+                        for iv in sub.invars:
+                            senv[iv] = fresh("var")
+                    for cv in sub.constvars:
+                        senv[cv] = fresh("const")
+                    walk(sub, senv)
+                    if aligned:
+                        for ov, io in zip(eqn.outvars, sub.outvars):
+                            env_[ov] = (
+                                senv[io]
+                                if io in senv
+                                else fresh("var")
+                            )
+                if not aligned:
+                    for ov in eqn.outvars:
+                        env_[ov] = fresh("var")
+                continue
+            ins = [read(env_, a) for a in eqn.invars]
+            in_d = tuple(
+                str(getattr(a.aval, "dtype", "?")) for a in eqn.invars
+            )
+            out_d = tuple(
+                str(getattr(v.aval, "dtype", "?")) for v in eqn.outvars
+            )
+            nm = eqn.primitive.name
+            # every op gets fresh OUT ids (so it appears in the graph),
+            # but pass-through ops forward their first operand's ORIGIN
+            # — the invariant _dot_kind's 'proj' classification rests on
+            out_origin = (
+                ins[0][1] if nm in _PASSTHRU and ins else "var"
+            )
+            rec_outs = []
+            for ov in eqn.outvars:
+                vid, _ = fresh(out_origin)
+                env_[ov] = (vid, out_origin)
+                rec_outs.append(vid)
+            ops.append(Op(
+                idx=len(ops),
+                prim=nm,
+                in_dtypes=in_d,
+                out_dtypes=out_d,
+                in_ids=tuple(vid for vid, _ in ins),
+                out_ids=tuple(rec_outs),
+                in_origins=tuple(origin for _, origin in ins),
+            ))
+
+    walk(jaxpr, env)
+    return FlatGraph(ops)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+# one record of a normalized trace: (kind, in_dtypes, out_dtypes)
+TraceRec = tp.Tuple[str, tp.Tuple[str, ...], tp.Tuple[str, ...]]
+
+
+def _is_float_op(op: Op) -> bool:
+    return bool(
+        (set(op.in_dtypes) | set(op.out_dtypes)) & _FLOAT_DTYPES
+    )
+
+
+def _dot_kind(op: Op) -> str:
+    """'proj' for a weight matmul (an operand traces to a program entry
+    parameter through pass-through ops only — the model pytree is an
+    ENTRY PARAMETER of every serving program, PR 6), 'rope' for the
+    const rotation-matrix contraction of apply_rotary, 'dot' for a
+    data-data contraction (QK scores / PV)."""
+    if "invar" in op.in_origins:
+        return "proj"
+    if "const" in op.in_origins:
+        return "rope"
+    return "dot"
+
+
+def normalized_trace(graph: FlatGraph) -> tp.List[TraceRec]:
+    """The program's float arithmetic as (kind, in_dtypes, out_dtypes)
+    records in program order — the 'op-and-dtype trace'. Shapes are
+    deliberately absent (decode is T=1, verify T=spec+1, a chunk T=N;
+    the choreography contract is about dtypes and order, not widths)."""
+    out: tp.List[TraceRec] = []
+    for op in graph.ops:
+        if op.prim not in _ARITH or not _is_float_op(op):
+            continue
+        kind = _dot_kind(op) if op.prim == "dot_general" else op.prim
+        out.append((kind, op.in_dtypes, op.out_dtypes))
+    return out
+
+
+def attention_regions(graph: FlatGraph) -> tp.List[tp.List[TraceRec]]:
+    """Per-layer normalized ATTENTION traces: the arithmetic between the
+    QKV projection and the output projection of each layer, located as
+    the inter-'proj' region containing that layer's joint softmax (its
+    ``exp``). One region per transformer layer; programs traced at the
+    same depth must produce the same number of regions."""
+    trace = normalized_trace(graph)
+    regions: tp.List[tp.List[TraceRec]] = []
+    current: tp.List[TraceRec] = []
+    has_exp = False
+    for rec in trace:
+        if rec[0] == "proj":
+            if has_exp:
+                regions.append(current)
+            current = []
+            has_exp = False
+            continue
+        current.append(rec)
+        if rec[0] == "exp":
+            has_exp = True
+    if has_exp:  # trailing region (no proj after — not the case today)
+        regions.append(current)
+    return regions
+
+
+@dataclasses.dataclass(frozen=True)
+class SoftmaxSignature:
+    """The softmax-core dtype choreography of one attention subgraph —
+    the facts the PR 4/PR 5 bugs flipped, independent of how many score
+    blocks feed the joint softmax (decode: pool+recent; verify:
+    pool+self; prefill: pool+self; naive: one)."""
+
+    # canonical score contractions feeding the softmax: each is
+    # ('dot' | 'mulsum', multiply operand dtypes, accumulation dtype)
+    qk_contracts: tp.FrozenSet[tp.Tuple[str, tp.Tuple[str, ...], str]]
+    mask_add_dtypes: tp.FrozenSet[tp.Tuple[str, ...]]  # additive-mask adds
+    scale_op: str  # 'div' | 'mul' — the 1/sqrt(C) application
+    scale_before_mask: bool  # True = scale applied before the mask add
+    softmax_dtype: str  # exp operand/result dtype
+    # probability operand dtype entering the PV contraction(s), and the
+    # canonical PV contractions themselves
+    probs_dtype: tp.FrozenSet[str]
+    pv_contracts: tp.FrozenSet[tp.Tuple[str, tp.Tuple[str, ...], str]]
+
+    def describe(self) -> str:
+        return (
+            f"qk={sorted(self.qk_contracts)} "
+            f"mask_adds={sorted(self.mask_add_dtypes)} "
+            f"scale={self.scale_op}"
+            f"{' (before mask)' if self.scale_before_mask else ''} "
+            f"softmax={self.softmax_dtype} "
+            f"probs->pv={sorted(self.probs_dtype)} "
+            f"pv={sorted(self.pv_contracts)}"
+        )
+
+
+def _canonical_contract(
+    graph: FlatGraph, op: Op
+) -> tp.Tuple[str, tp.Tuple[str, ...], str]:
+    """Canonicalize a contraction: a ``dot_general`` keeps its operand
+    dtypes with the dot's own output as the accumulation dtype; a
+    ``reduce_sum``-over-``mul`` (the decode path's VPU broadcast-multiply
+    form) reports the MULTIPLY's operand dtypes with the reduce's output
+    as the accumulation dtype. Numerically these are the same object —
+    'what dtypes are the products formed at, what dtype do they sum in'."""
+    if op.prim == "dot_general":
+        return ("dot", op.in_dtypes, op.out_dtypes[0])
+    assert op.prim == "reduce_sum", op.prim
+    src = graph.producer.get(op.in_ids[0])
+    if src is not None and src.prim == "mul":
+        return ("mulsum", src.in_dtypes, op.out_dtypes[0])
+    return ("sum", op.in_dtypes, op.out_dtypes[0])
+
+
+def _backward_ops(
+    graph: FlatGraph, start_ids: tp.Iterable[int], *, limit: int = 200
+) -> tp.List[Op]:
+    """Producer-closure walk from ``start_ids``, stopping at contraction
+    boundaries (dot_general / reduce_sum) — those are collected but not
+    walked past, so the slice stays inside one softmax's score path."""
+    seen: tp.Set[int] = set()
+    out: tp.List[Op] = []
+    stack = list(start_ids)
+    while stack and len(out) < limit:
+        vid = stack.pop()
+        op = graph.producer.get(vid)
+        if op is None or op.idx in seen:
+            continue
+        seen.add(op.idx)
+        out.append(op)
+        if op.prim in ("dot_general", "reduce_sum"):
+            continue  # boundary: a contraction starts a new segment
+        stack.extend(i for i in op.in_ids if i >= 0)
+    return out
+
+
+def _leads_to_contract(
+    graph: FlatGraph, vid: int, *, limit: int = 60
+) -> bool:
+    """Does ``vid``'s producer subtree contain a data-data contraction
+    (a QK score block)? Distinguishes the score-carrying operand of a
+    scale/mask op from the scalar/mask operand."""
+    seen: tp.Set[int] = set()
+    stack = [vid]
+    while stack and len(seen) < limit:
+        v = stack.pop()
+        op = graph.producer.get(v)
+        if op is None or op.idx in seen:
+            continue
+        seen.add(op.idx)
+        if op.prim == "dot_general" and _dot_kind(op) == "dot":
+            return True
+        if op.prim == "reduce_sum":
+            src = graph.producer.get(op.in_ids[0])
+            if src is not None and src.prim == "mul":
+                return True
+            continue
+        stack.extend(i for i in op.in_ids if i >= 0)
+    return False
+
+
+def softmax_signature(
+    graph: FlatGraph, exp_op: Op
+) -> SoftmaxSignature:
+    """Extract the :class:`SoftmaxSignature` around one ``exp``."""
+    # --- the score chain: walk BACKWARD from the softmax argument
+    # through the score-carrying operand of each div/mul/add, recording
+    # the order the scale and the additive mask were applied in (the
+    # walk sees last-applied first)
+    sub = graph.producer.get(exp_op.in_ids[0])
+    chain: tp.List[str] = []  # 'div' | 'mul' | 'mask', last-applied first
+    mask_adds: tp.Set[tp.Tuple[str, ...]] = set()
+    vid = sub.in_ids[0] if sub is not None else exp_op.in_ids[0]
+    for _ in range(32):
+        op = graph.producer.get(vid)
+        if op is None:
+            break
+        if op.prim in _PASSTHRU or op.prim == "concatenate":
+            # a concatenated joint softmax: every branch shares the
+            # suffix arithmetic by construction; follow branch 0
+            vid = op.in_ids[0]
+            continue
+        if op.prim in ("div", "mul", "add"):
+            score_side = [
+                i for i in op.in_ids
+                if i >= 0 and _leads_to_contract(graph, i)
+            ]
+            if not score_side:
+                break
+            if op.prim == "add":
+                chain.append("mask")
+                mask_adds.add(op.in_dtypes)
+            else:
+                chain.append(op.prim)
+            vid = score_side[0]
+            continue
+        break  # the QK contraction (or something unexpected): done
+    scale_op = next((c for c in chain if c != "mask"), "?")
+    # the walk sees last-applied first: scale BEFORE mask means the
+    # scale shows up AFTER a mask entry in the chain
+    scale_before_mask = (
+        "mask" in chain
+        and scale_op in chain
+        and chain.index(scale_op) > chain.index("mask")
+    )
+
+    # --- score contractions: the contraction boundaries of the
+    # backward slice (qk-norm/rope arithmetic sits behind them and is
+    # never reached; proj/rope dots are classified out)
+    back = _backward_ops(graph, [i for i in exp_op.in_ids if i >= 0])
+    qk: tp.Set[tp.Tuple[str, tp.Tuple[str, ...], str]] = set()
+    for op in back:
+        if op.prim == "dot_general" and _dot_kind(op) == "dot":
+            qk.add(_canonical_contract(graph, op))
+        elif op.prim == "reduce_sum":
+            rec = _canonical_contract(graph, op)
+            if rec[0] == "mulsum":
+                qk.add(rec)
+    # --- forward: exp -> reduce_sum -> div (normalize) -> [convert] -> PV
+    denom_div = None
+    for c in graph.consumers.get(exp_op.out_ids[0], []):
+        if c.prim == "div":
+            denom_div = c
+            break
+        if c.prim == "reduce_sum":
+            for c2 in graph.consumers.get(c.out_ids[0], []):
+                if c2.prim == "div":
+                    denom_div = c2
+                    break
+    probs_dtype: tp.Set[str] = set()
+    pv: tp.Set[tp.Tuple[str, tp.Tuple[str, ...], str]] = set()
+    if denom_div is not None:
+        frontier = [denom_div.out_ids[0]]
+        hops = 0
+        while frontier and hops < 64:
+            hops += 1
+            vid = frontier.pop()
+            for c in graph.consumers.get(vid, []):
+                if c.prim == "dot_general":
+                    pv.add(_canonical_contract(graph, c))
+                    probs_dtype.add(c.in_dtypes[0])
+                elif c.prim == "mul":
+                    # decode's VPU form: probs * values, then reduce_sum
+                    reduced = False
+                    for c2 in graph.consumers.get(c.out_ids[0], []):
+                        if c2.prim == "reduce_sum":
+                            pv.add(_canonical_contract(graph, c2))
+                            probs_dtype.add(c.in_dtypes[0])
+                            reduced = True
+                    if not reduced:
+                        frontier.append(c.out_ids[0])
+                elif c.prim in _PASSTHRU or c.prim in (
+                    "concatenate", "dynamic_slice", "gather",
+                ):
+                    frontier.extend(c.out_ids)
+    return SoftmaxSignature(
+        qk_contracts=frozenset(qk),
+        mask_add_dtypes=frozenset(mask_adds),
+        scale_op=scale_op,
+        scale_before_mask=scale_before_mask,
+        softmax_dtype=exp_op.out_dtypes[0],
+        probs_dtype=frozenset(probs_dtype),
+        pv_contracts=frozenset(pv),
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-program choreography
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramChoreography:
+    """Everything the prover compares about one traced program."""
+
+    name: str
+    # the representative per-layer attention trace (all layers asserted
+    # identical) and the number of layers seen
+    attention: tp.Tuple[TraceRec, ...]
+    n_layers: int
+    softmax: SoftmaxSignature
+    # the lm-head projection: operand dtypes + whether the quantized
+    # dequant-epilogue multiply follows it
+    lm_head: tp.Optional[TraceRec]
+    lm_head_epilogue: bool
+
+
+def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
+    """Normalize one traced program into its comparable choreography."""
+    graph = flatten_jaxpr(closed_jaxpr)
+    regions = attention_regions(graph)
+    assert regions, f"{name}: no attention softmax found in the trace"
+    rep = tuple(regions[0])
+    for i, r in enumerate(regions[1:], start=2):
+        assert tuple(r) == rep, (
+            f"{name}: layer {i}'s attention trace differs from layer 1 "
+            f"— the stacked layers do not share one choreography"
+        )
+    exps = [
+        op for op in graph.ops
+        if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+    ]
+    sig = softmax_signature(graph, exps[0])
+    for e in exps[1:]:
+        s2 = softmax_signature(graph, e)
+        assert s2 == sig, (
+            f"{name}: softmax signatures differ between layers:\n"
+            f"  {sig.describe()}\n  {s2.describe()}"
+        )
+    # lm head: the LAST weight projection in program order, plus its
+    # epilogue (a following multiply whose other operand is an entry
+    # parameter — the QuantLinear per-channel scale)
+    lm = None
+    lm_op = None
+    for op in graph.ops:
+        if op.prim == "dot_general" and _dot_kind(op) == "proj":
+            lm_op = op
+    if lm_op is not None:
+        lm = ("proj", lm_op.in_dtypes, lm_op.out_dtypes)
+    epilogue = False
+    if lm_op is not None:
+        for c in graph.consumers.get(lm_op.out_ids[0], []):
+            if c.prim == "mul" and "invar" in c.in_origins:
+                epilogue = True
+    return ProgramChoreography(
+        name=name,
+        attention=rep,
+        n_layers=len(regions),
+        softmax=sig,
+        lm_head=lm,
+        lm_head_epilogue=epilogue,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the prover
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChoreoCheck:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChoreoReport:
+    checks: tp.Tuple[ChoreoCheck, ...]
+    programs: tp.Tuple[ProgramChoreography, ...]
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    def to_dict(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "ok": self.ok,
+            "checks": [
+                {"name": c.name, "ok": c.ok, "detail": c.detail}
+                for c in self.checks
+            ],
+            "programs": {
+                p.name: {
+                    "n_layers": p.n_layers,
+                    "attention_ops": len(p.attention),
+                    "attention": [
+                        [k, list(i), list(o)] for k, i, o in p.attention
+                    ],
+                    "softmax": p.softmax.describe(),
+                    "lm_head": list(p.lm_head) if p.lm_head else None,
+                    "lm_head_epilogue": p.lm_head_epilogue,
+                }
+                for p in self.programs
+            },
+        }
+
+
+def _first_diff(a: tp.Sequence[TraceRec], b: tp.Sequence[TraceRec]) -> str:
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if ra != rb:
+            return f"op {i}: {ra} != {rb}"
+    if len(a) != len(b):
+        return f"length {len(a)} != {len(b)}"
+    return ""
+
+
+def prove_choreography(
+    decode: ProgramChoreography,
+    prefill: ProgramChoreography,
+    verify: ProgramChoreography,
+    naive: ProgramChoreography,
+) -> ChoreoReport:
+    """Evaluate the three serving-choreography contracts (module
+    docstring). ``naive`` is the reference trace of
+    ``ops.attention.naive_attention`` — what the monolithic prefill (and
+    the training forward) computes."""
+    checks: tp.List[ChoreoCheck] = []
+
+    # 1. verify mirrors decode OP FOR OP (the PR 5 contract)
+    diff = _first_diff(decode.attention, verify.attention)
+    sig_ok = decode.softmax == verify.softmax
+    checks.append(ChoreoCheck(
+        name="verify-mirrors-decode",
+        ok=not diff and sig_ok,
+        detail=diff or (
+            ""
+            if sig_ok
+            else f"softmax: {decode.softmax.describe()} != "
+            f"{verify.softmax.describe()}"
+        ),
+    ))
+
+    # 2. the prefill chunk's softmax core mirrors naive_attention (the
+    # PR 4 contract); full-sequence equality is not expected (the chunk
+    # has a pool score block and rope/qk-norm the bare reference lacks)
+    checks.append(ChoreoCheck(
+        name="prefill-mirrors-naive",
+        ok=prefill.softmax == naive.softmax,
+        detail=(
+            ""
+            if prefill.softmax == naive.softmax
+            else f"{prefill.softmax.describe()} != "
+            f"{naive.softmax.describe()}"
+        ),
+    ))
+
+    # 3. shared arithmetic across all three serving programs
+    progs = (decode, prefill, verify)
+    shared: tp.List[tp.Tuple[str, bool, str]] = []
+    sm = {p.softmax.softmax_dtype for p in progs}
+    shared.append((
+        "softmax runs in f32 everywhere",
+        sm == {"float32"},
+        f"softmax dtypes {sorted(sm)}",
+    ))
+    # PV accumulation is contract-specific (decode keeps f32 probs and
+    # sums, the prefill chunk mirrors naive_attention's value-dtype
+    # einsum) and is pinned per program by checks 1 and 2 — the SHARED
+    # invariant is the score accumulation
+    accs = {
+        acc for p in progs for (_, _, acc) in p.softmax.qk_contracts
+    }
+    shared.append((
+        "scores accumulate in f32 everywhere",
+        accs == {"float32"},
+        f"score accumulation dtypes {sorted(accs)}",
+    ))
+    sbm = {p.softmax.scale_before_mask for p in progs}
+    shared.append((
+        "mask is added before the softmax scale everywhere",
+        sbm == {False},
+        f"scale_before_mask {sorted(sbm)}",
+    ))
+    heads = {(p.lm_head, p.lm_head_epilogue) for p in progs}
+    shared.append((
+        "lm-head projection choreography is identical everywhere",
+        len(heads) == 1,
+        "; ".join(
+            f"{p.name}: {p.lm_head} epilogue={p.lm_head_epilogue}"
+            for p in progs
+        ),
+    ))
+    layer_depths = {p.n_layers for p in progs}
+    shared.append((
+        "all programs traced at one depth",
+        len(layer_depths) == 1,
+        f"layer counts {sorted(layer_depths)}",
+    ))
+    for name, ok, detail in shared:
+        checks.append(ChoreoCheck(
+            name=f"shared: {name}", ok=ok, detail="" if ok else detail
+        ))
+
+    return ChoreoReport(
+        checks=tuple(checks),
+        programs=(decode, prefill, verify, naive),
+    )

@@ -351,6 +351,34 @@ def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
     return model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh
 
 
+def serving_stream_keys(model, pool, logits) -> tp.Dict[str, tp.FrozenSet]:
+    """(dtype, shape) classification keys for the HBM traffic auditor
+    (:func:`midgpt_tpu.analysis.traffic.traffic_report`), built from the
+    live trees a serving program was compiled against — so the auditor
+    classifies exactly the buffers the program streams, not a guess at
+    them. Shard-LOCAL shapes under a mesh (what the partitioned HLO's
+    entry interface contains)."""
+    import jax
+
+    from midgpt_tpu.analysis.traffic import hlo_dtype
+
+    def local_key(arr) -> tp.Tuple[str, tp.Tuple[int, ...]]:
+        sharding = getattr(arr, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = tuple(int(d) for d in sharding.shard_shape(arr.shape))
+        else:
+            shape = tuple(int(d) for d in arr.shape)
+        return (hlo_dtype(arr.dtype), shape)
+
+    return {
+        "weights": frozenset(
+            local_key(x) for x in jax.tree.leaves(model)
+        ),
+        "kv": frozenset(local_key(x) for x in jax.tree.leaves(pool)),
+        "logits": frozenset([local_key(logits)]),
+    }
+
+
 def _serving_rules(
     wshapes,
     payload_shapes: tp.Optional[tp.FrozenSet] = None,
@@ -377,6 +405,30 @@ def _serving_rules(
     if payload_shapes:
         rules.append(NoPageGatherAllGather(payload_shapes, slots or 1))
     return RuleSet(rules)
+
+
+def _serving_traffic(
+    program: str,
+    analysis: StepAnalysis,
+    stream_keys: tp.Mapping[str, tp.FrozenSet],
+    *,
+    window_steps: int,
+):
+    """Build the HBM :class:`~midgpt_tpu.analysis.traffic.TrafficReport`
+    for one compiled serving program: entry-interface streams classified
+    against the live trees' keys, plus the per-dispatch collective wire
+    bytes (sharded geometries) so a pool-payload regather moves a budget
+    number, not just an HLO shape."""
+    from midgpt_tpu.analysis.traffic import traffic_report
+
+    comms = sum(c.traffic_bytes for c in analysis.collectives)
+    return traffic_report(
+        analysis.hlo,
+        program=program,
+        stream_keys=stream_keys,
+        window_steps=window_steps,
+        comms_bytes=comms,
+    )
 
 
 def compile_decode_window(
@@ -440,7 +492,11 @@ def compile_decode_window(
     )
     # return the AUDITED model's block size: with shrink it differs from
     # cfg's, and geometry-dependent rules must see the compiled program's
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
+    keys = serving_stream_keys(model, pool, logits)
+    return (
+        hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload,
+        keys,
+    )
 
 
 def audit_decode_window(
@@ -452,7 +508,8 @@ def audit_decode_window(
     shrink: bool = True,
     quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
-) -> tp.Tuple[StepAnalysis, Report]:
+    traffic: bool = False,
+):
     """One-call serving audit: compile the fused decode window and check
     the serving invariants (donation-intact, no-host-sync, no-f64 —
     plus no-dequant-materialization when ``quant``, plus
@@ -463,9 +520,11 @@ def audit_decode_window(
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes, payload = compile_decode_window(
-        cfg, slots=slots, window=window, page_size=page_size,
-        shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+    hlo, mesh, donated, block, wshapes, payload, keys = (
+        compile_decode_window(
+            cfg, slots=slots, window=window, page_size=page_size,
+            shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+        )
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -475,6 +534,10 @@ def audit_decode_window(
         donated_leaves=donated,
     )
     report = _serving_rules(wshapes, payload, slots).evaluate(analysis)
+    if traffic:
+        return analysis, report, _serving_traffic(
+            "decode_window", analysis, keys, window_steps=window
+        )
     return analysis, report
 
 
@@ -531,7 +594,11 @@ def compile_prefill_chunk(
         if prog_mesh is not None
         else None
     )
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
+    keys = serving_stream_keys(model, pool, logits)
+    return (
+        hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload,
+        keys,
+    )
 
 
 def audit_prefill_chunk(
@@ -542,7 +609,8 @@ def audit_prefill_chunk(
     shrink: bool = True,
     quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
-) -> tp.Tuple[StepAnalysis, Report]:
+    traffic: bool = False,
+):
     """One-call audit of the prefill-chunk program: donation-intact,
     no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
     — the CI serving-audit job runs this next to
@@ -554,9 +622,11 @@ def audit_prefill_chunk(
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes, payload = compile_prefill_chunk(
-        cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
-        quant=quant, mesh_shape=mesh_shape,
+    hlo, mesh, donated, block, wshapes, payload, keys = (
+        compile_prefill_chunk(
+            cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
+            quant=quant, mesh_shape=mesh_shape,
+        )
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -566,6 +636,10 @@ def audit_prefill_chunk(
         donated_leaves=donated,
     )
     report = _serving_rules(wshapes, payload, 1).evaluate(analysis)
+    if traffic:
+        return analysis, report, _serving_traffic(
+            "prefill_chunk", analysis, keys, window_steps=1
+        )
     return analysis, report
 
 
@@ -624,7 +698,11 @@ def compile_verify_program(
         if prog_mesh is not None
         else None
     )
-    return hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload
+    keys = serving_stream_keys(model, pool, logits)
+    return (
+        hlo, mesh, donated_leaves, model_cfg.block_size, wshapes, payload,
+        keys,
+    )
 
 
 def audit_verify_program(
@@ -636,7 +714,8 @@ def audit_verify_program(
     shrink: bool = True,
     quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
-) -> tp.Tuple[StepAnalysis, Report]:
+    traffic: bool = False,
+):
     """One-call audit of the speculative verify program: donation-intact,
     no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
     — the CI serving-audit job runs this next to
@@ -647,9 +726,11 @@ def audit_verify_program(
         if isinstance(name_or_cfg, str)
         else name_or_cfg
     )
-    hlo, mesh, donated, block, wshapes, payload = compile_verify_program(
-        cfg, slots=slots, spec_len=spec_len, page_size=page_size,
-        shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+    hlo, mesh, donated, block, wshapes, payload, keys = (
+        compile_verify_program(
+            cfg, slots=slots, spec_len=spec_len, page_size=page_size,
+            shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+        )
     )
     analysis = StepAnalysis.from_text(
         hlo,
@@ -659,7 +740,101 @@ def audit_verify_program(
         donated_leaves=donated,
     )
     report = _serving_rules(wshapes, payload, slots).evaluate(analysis)
+    if traffic:
+        return analysis, report, _serving_traffic(
+            "verify_program", analysis, keys, window_steps=1
+        )
     return analysis, report
+
+
+def prove_serving_choreography(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    slots: int = 4,
+    window: int = 2,
+    spec_len: int = 2,
+    chunk_len: int = 16,
+    page_size: int = 16,
+    quant: bool = False,
+):
+    """Run the arithmetic-choreography prover
+    (:mod:`midgpt_tpu.analysis.choreo`) over the three serving programs
+    of ``cfg``'s model family: trace each program to a jaxpr (through
+    the very jitted callables the engine launches), slice out the
+    attention and lm-head subgraphs, normalize them into op-and-dtype
+    traces, and prove the three contracts — verify mirrors decode op
+    for op (PR 5), the prefill chunk's softmax core mirrors
+    ``naive_attention`` (PR 4), and the shared arithmetic (f32 softmax
+    and accumulation, mask-before-scale, one lm-head choreography)
+    holds everywhere. Returns a :class:`~midgpt_tpu.analysis.choreo.\
+ChoreoReport`.
+
+    Traced at choreography size (2 layers, block 64, vocab 128): the
+    contract is per-layer-identical by construction (asserted by the
+    extractor), so depth and width add nothing but trace time. No
+    compilation happens — a full proof is seconds on CPU. ``quant``
+    proves the int8 path instead (same contracts; the lm-head check
+    additionally pins the dequant epilogue everywhere)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.analysis.choreo import (
+        extract_choreography,
+        prove_choreography,
+    )
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.ops.attention import naive_attention
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving.engine import trace_serving_programs
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    model_cfg = _dc.replace(
+        cfg.model, n_layer=2, block_size=64, vocab_size=128,
+        remat="none", scan_unroll=1,
+    )
+    model = cast_floating(
+        GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16
+    )
+    if quant:
+        from midgpt_tpu.quant import quantize_model
+
+        model = quantize_model(model)
+    jaxprs = trace_serving_programs(
+        model, slots=slots, window=window, spec_len=spec_len,
+        chunk_len=chunk_len, page_size=page_size,
+    )
+
+    # the naive reference: what the monolithic prefill / training
+    # forward computes (ops.attention docstring: the correctness
+    # oracle). q/k/v are derived from the traced input by an identity
+    # multiply so the score contraction's operands are computed values,
+    # not entry parameters (the prover classifies parameter-operand
+    # contractions as weight projections).
+    h, hkv, c = model_cfg.n_head, model_cfg.kv_heads, model_cfg.head_dim
+    t = 8
+
+    def naive_ref(x):
+        one = jnp.asarray(1.0, x.dtype)
+        q = x[:, :h] * one
+        k = x[:, h : h + hkv] * one
+        v = x[:, h + hkv :] * one
+        return naive_attention(q, k, v, causal=True)
+
+    naive_jaxpr = jax.make_jaxpr(naive_ref)(
+        jax.ShapeDtypeStruct((1, h + 2 * hkv, t, c), jnp.bfloat16)
+    )
+    return prove_choreography(
+        decode=extract_choreography("decode_window", jaxprs["decode_window"]),
+        prefill=extract_choreography("prefill_chunk", jaxprs["prefill_chunk"]),
+        verify=extract_choreography("verify", jaxprs["verify"]),
+        naive=extract_choreography("naive_reference", naive_jaxpr),
+    )
 
 
 def train_step_comms_summary(cfg: ExperimentConfig) -> tp.Dict[str, tp.Any]:

@@ -18,6 +18,16 @@ check lazily reads the canonical axis names from ``parallel.mesh``):
   state-threading function (first parameter named ``state`` /
   ``train_state``) without ``donate_argnums``/``donate_argnames``: the
   step would hold two copies of params + optimizer state in HBM.
+- ``no-model-closure-jit`` — in ``midgpt_tpu/serving/`` modules only: a
+  ``jax.jit``/``pjit``/``filter_jit`` whose traced function references
+  ``model`` as a FREE variable (a closure or global capture) instead of
+  taking it as a parameter. Closed over, jax bakes every weight into
+  the executable as an HLO constant — and for a quantized model XLA
+  constant-folds the dequant back into full f32 matrices, silently
+  doubling the weight stream the int8 path halves (the PR 6 bug,
+  caught here at the AST level before anything compiles; the
+  ``no-dequant-materialization`` HLO rule and the traffic budget gate
+  are the compile-time backstops).
 
 Findings are waivable inline with ``# shardlint: disable=<rule>`` (or a
 bare ``# shardlint: disable`` for all rules) on the offending line —
@@ -44,6 +54,10 @@ RULES = {
     "host-sync-in-jit": "host-device sync inside jit/traced code",
     "unknown-mesh-axis": "PartitionSpec axis literal not a declared mesh axis",
     "missing-donate": "jax.jit on a state-threading function without donation",
+    "no-model-closure-jit": (
+        "serving jit captures the model instead of taking it as a "
+        "parameter"
+    ),
 }
 
 # call targets whose function arguments are traced/compiled
@@ -302,6 +316,125 @@ class _ModuleLint:
                             f"{node.name!r} without donate_argnums",
                         )
 
+    def check_model_closure(self) -> None:
+        """``no-model-closure-jit``: any jitted function in a serving
+        module that references the model as a free variable. The PR 6
+        bug class, caught before a single compile: jax bakes a captured
+        model's weights into the executable as constants (and constant-
+        folds a quantized model's dequant back to full f32 matrices)."""
+        def flag_if_captured(fn: tp.Optional[ast.AST], lineno: int,
+                             desc: str) -> None:
+            if fn is None:
+                return
+            captured = _free_names(fn) & _MODEL_NAMES
+            if captured:
+                self.add(
+                    lineno,
+                    "no-model-closure-jit",
+                    f"{desc} captures {sorted(captured)} from the "
+                    "enclosing scope instead of taking it as a "
+                    "parameter — jit bakes the weights into the "
+                    "executable as constants (and constant-folds a "
+                    "quantized model's dequant back to f32)",
+                )
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                if _tail(_dotted(node.func)) not in _JIT_ENTRIES:
+                    continue
+                target = node.args[0] if node.args else None
+                fn = (
+                    self.defs.get(target.id)
+                    if isinstance(target, ast.Name)
+                    else target
+                )
+                name = (
+                    target.id
+                    if isinstance(target, ast.Name)
+                    else "<lambda>"
+                )
+                flag_if_captured(
+                    fn, node.lineno, f"jitted function {name!r}"
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    d = deco.func if isinstance(deco, ast.Call) else deco
+                    entry = _tail(_dotted(d))
+                    applies = entry in _JIT_ENTRIES or (
+                        entry == "partial"
+                        and isinstance(deco, ast.Call)
+                        and deco.args
+                        and _tail(_dotted(deco.args[0])) in _JIT_ENTRIES
+                    )
+                    if applies:
+                        flag_if_captured(
+                            node, deco.lineno,
+                            f"jit-decorated function {node.name!r}",
+                        )
+
+
+def _free_names(fn: ast.AST) -> tp.Set[str]:
+    """Names a function LOADS but never binds — its closure/global
+    captures, to the static approximation one module allows. Scope-
+    aware: each nested def/lambda is resolved in ITS OWN scope first
+    (its params and local Stores bind only there), and only its
+    residual free names propagate out — so a nested helper's `model`
+    parameter neither hides an enclosing capture nor fabricates one."""
+    bound: tp.Set[str] = set()
+    loaded: tp.Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in [
+            *a.args, *a.kwonlyargs, *getattr(a, "posonlyargs", []),
+        ]:
+            bound.add(arg.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                bound.add(child.name)
+                loaded.update(_free_names(child))
+                # decorators and defaults evaluate in THIS scope
+                for d in child.decorator_list:
+                    visit(d)
+                for d in [
+                    *child.args.defaults,
+                    *[x for x in child.args.kw_defaults if x],
+                ]:
+                    visit(d)
+                continue
+            if isinstance(child, ast.Lambda):
+                loaded.update(_free_names(child))
+                for d in [
+                    *child.args.defaults,
+                    *[x for x in child.args.kw_defaults if x],
+                ]:
+                    visit(d)
+                continue
+            if isinstance(child, ast.Name):
+                if isinstance(child.ctx, (ast.Store, ast.Del)):
+                    bound.add(child.id)
+                else:
+                    loaded.add(child.id)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                for alias in child.names:
+                    bound.add((alias.asname or alias.name).split(".")[0])
+            visit(child)
+
+    visit(fn)
+    return loaded - bound
+
+
+# the captured names the serving closure rule flags: the model pytree
+# must always be an ENTRY PARAMETER of a jitted serving program
+_MODEL_NAMES = {"model", "qmodel"}
+
 
 def lint_source(source: str, path: str = "<string>") -> tp.List[Finding]:
     """Lint one module's source text."""
@@ -310,6 +443,12 @@ def lint_source(source: str, path: str = "<string>") -> tp.List[Finding]:
     lint.check_host_sync()
     lint.check_mesh_axes()
     lint.check_missing_donate()
+    # the model-closure rule is scoped to the serving package: that is
+    # where every jitted program's model MUST be an entry parameter
+    # (engine.py's program cache and the int8 path both depend on it);
+    # trainers legitimately close over config-derived structures
+    if "serving" in Path(path).parts:
+        lint.check_model_closure()
     waivers = _pragma_waivers(source)
     findings = []
     for lineno, rule, message in sorted(lint.findings):

@@ -543,7 +543,12 @@ class Attention:
         fixed-batch sampler (a cast-to-f32-early variant drifted by ~2
         bf16 ulps in the pool K/V — enough to flip near-tied greedy
         argmaxes on a real checkpoint, caught by the sample.py --serve
-        verify drive)."""
+        verify drive). This contract is MACHINE-CHECKED: the
+        choreography prover (midgpt_tpu.analysis.choreo, CI
+        serving-choreo job) normalizes this subgraph's op-and-dtype
+        trace out of the compiled chunk program's jaxpr and asserts its
+        softmax core equals naive_attention's — a cast-early edit here
+        turns that gate red before anything compiles."""
         b, t, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
@@ -627,7 +632,12 @@ class Attention:
         class of flip PR 4 hit with a cast-early prefill variant).
         Mirroring the decode arithmetic pins spec-on to the decode
         path at f32-reduction granularity, the same equivalence class
-        as the tested K=4 vs K=1 window invariance."""
+        as the tested K=4 vs K=1 window invariance. This contract is
+        MACHINE-CHECKED: the choreography prover
+        (midgpt_tpu.analysis.choreo, CI serving-choreo job) asserts
+        the verify program's normalized attention trace equals the
+        decode window's OP FOR OP — a prefill-flavored edit here turns
+        that gate red before anything compiles."""
         b, t, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h

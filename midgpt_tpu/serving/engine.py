@@ -517,6 +517,76 @@ def _build_verify_program(
     return jax.jit(verify_fn, donate_argnums=(1, 2))
 
 
+def trace_serving_programs(
+    model: GPT,
+    *,
+    slots: int = 4,
+    window: int = 4,
+    spec_len: int = 4,
+    chunk_len: int = 64,
+    page_size: int = 16,
+    num_pages: tp.Optional[int] = None,
+    mesh=None,
+) -> tp.Dict[str, tp.Any]:
+    """Abstractly trace the engine's three hot-path programs to jaxprs —
+    the input of the arithmetic-choreography prover
+    (:mod:`midgpt_tpu.analysis.choreo`). Returns
+    ``{"decode_window": ClosedJaxpr, "prefill_chunk": ..., "verify": ...}``.
+
+    Tracing goes through the very same jitted callables the engine
+    launches (:func:`make_decode_window` et al.), so the prover sees the
+    program the hardware runs — model as an entry parameter, the fused
+    window scan, the in-program sampling/acceptance glue — not a
+    hand-maintained replica of it. No compilation, no execution: a full
+    three-program trace takes seconds on CPU at audit size."""
+    from midgpt_tpu.serving.paged import pages_needed
+
+    cfg = model.config
+    pmax = pages_needed(cfg.block_size, page_size)
+    if num_pages is None:
+        num_pages = slots * pmax
+    pool = jax.eval_shape(
+        lambda: PagedKVPool.init(cfg, num_pages, page_size)
+    )
+    f32 = jnp.float32
+    sds = jax.ShapeDtypeStruct
+    logits = sds((slots, cfg.vocab_size), f32)
+    i32 = lambda *s: sds(s, jnp.int32)  # noqa: E731
+    pred = lambda *s: sds(s, jnp.bool_)  # noqa: E731
+
+    window_fn = make_decode_window(
+        model, slots=slots, window=window, pmax=pmax,
+        rope_len=cfg.block_size, mesh=mesh,
+    )
+    decode_jaxpr = jax.make_jaxpr(window_fn)(
+        model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
+        i32(slots), i32(slots), i32(slots), i32(slots),
+        sds((2,), jnp.uint32),
+    )
+    chunk_fn = make_prefill_chunk_program(
+        model, chunk_len=chunk_len, pmax=pmax, rope_len=cfg.block_size,
+        mesh=mesh,
+    )
+    chunk_jaxpr = jax.make_jaxpr(chunk_fn)(
+        model, pool, logits, i32(), i32(1, chunk_len), i32(), i32(),
+        i32(pmax),
+    )
+    verify_fn = make_verify_program(
+        model, slots=slots, spec_len=spec_len, pmax=pmax,
+        rope_len=cfg.block_size, mesh=mesh,
+    )
+    verify_jaxpr = jax.make_jaxpr(verify_fn)(
+        model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
+        i32(slots), i32(slots), i32(slots), i32(slots, spec_len),
+        i32(slots),
+    )
+    return {
+        "decode_window": decode_jaxpr,
+        "prefill_chunk": chunk_jaxpr,
+        "verify": verify_jaxpr,
+    }
+
+
 def make_copy_page_program():
     """The jitted copy-on-write primitive: duplicate one page so an
     admission landing on a partially-shared cached page gets a private

@@ -17,6 +17,13 @@ clock, and emits ONE JSON record:
   serve_verify_dispatches     speculative verify dispatches
   serve_quant            int8 quantized weight path on/off
   serve_peak_hbm_bytes   device peak HBM after the trace (null on CPU)
+  serve_bytes_per_token_static  the analysis/traffic.py static HBM
+                         decomposition (weights + live KV + logits per
+                         decode step, per chip under --tp) at the
+                         trace's mean live context — the roofline the
+                         measured serve_tok_s is compared against, and
+                         the generator of PERF.md's floor table
+  serve_hbm_floor_ms_static     its ms/step floor at 800 GB/s
 
 The quantized weight path (--quant on) converts the model to the int8
 per-channel pytree (midgpt_tpu.quant) before the engine compiles its
@@ -283,7 +290,7 @@ def main() -> None:
             from midgpt_tpu.analysis.rules import StepAnalysis
 
             exp = dataclasses.replace(get_config("openwebtext"), model=cfg)
-            hlo, amesh, donated, blk, _, _ = compile_decode_window(
+            hlo, amesh, donated, blk, _, _, _ = compile_decode_window(
                 exp, slots=args.slots, window=args.window,
                 page_size=args.page_size, shrink=False,
                 quant=args.quant == "on", mesh_shape={"tensor": args.tp},
@@ -299,6 +306,25 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — summary is best-effort
             print(f"comms summary skipped: {e}", file=sys.stderr)
             comms_bytes = None
+
+    # static HBM decomposition for THIS trace's geometry (analysis/
+    # traffic.py — the same arithmetic that generates PERF.md's floor
+    # table): weight + live-KV + logits streams per decode step at the
+    # trace's mean live context, per chip under TP. Recorded next to
+    # the measured tok/s so the floor PERF.md compares against is
+    # generated, not hand-computed.
+    from midgpt_tpu.analysis.traffic import floor_decomposition
+
+    # mean over the FINAL prompt list (includes the shared system
+    # prefix and repetitive tiling): those tokens are live KV context
+    # during decode exactly like any other prompt token
+    live_mean = float(
+        np.mean([p.size for p in prompts]) + np.mean(nnews) / 2.0
+    )
+    static = floor_decomposition(
+        cfg, slots=args.slots, live_tokens=live_mean,
+        quant=args.quant == "on", tp_degree=args.tp,
+    )
 
     ttfts = sorted(
         (r.first_token_time - r.submit_time) * 1e3
@@ -324,6 +350,14 @@ def main() -> None:
         "serve_comms_collective_count": comms_count,
         "serve_quant": args.quant,
         "serve_peak_hbm_bytes": peak_hbm,
+        "serve_bytes_per_token_static": static["bytes_per_token"],
+        "serve_bytes_per_step_static": static["bytes_per_step"],
+        "serve_weights_bytes_per_step_static": static[
+            "weights_bytes_per_step"
+        ],
+        "serve_kv_bytes_per_step_static": static["kv_bytes_per_step"],
+        "serve_hbm_floor_ms_static": static["floor_ms_per_step"],
+        "serve_static_live_tokens": round(live_mean, 1),
         "serve_requests": args.requests,
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
         "serve_wall_s": round(wall, 3),

@@ -82,3 +82,35 @@ def pytest_collection_modifyitems(config, items):
         name = item.name.split("[", 1)[0]
         if mod in _SMOKE_ALL or name in _SMOKE_TESTS.get(mod, ()):
             item.add_marker(pytest.mark.smoke)
+
+
+# ---------------------------------------------------------------------------
+# Wall-clock accounting: tier-1 runs under a hard timeout (ROADMAP.md's
+# 870 s verify line), and the budget has been breached by slow boxes
+# before (PR 7's CHANGES entry). Print the top-10 slowest CALL phases at
+# the end of every session so a test drifting toward the ~20 s
+# move-to-slow-tier threshold is visible in every run's output instead
+# of discovered by a timeout. (pytest's own --durations is opt-in;
+# this makes the accounting permanent.)
+# ---------------------------------------------------------------------------
+
+_CALL_DURATIONS: list = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _CALL_DURATIONS.append((report.duration, report.nodeid))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _CALL_DURATIONS:
+        return
+    top = sorted(_CALL_DURATIONS, reverse=True)[:10]
+    total = sum(d for d, _ in _CALL_DURATIONS)
+    terminalreporter.write_sep(
+        "-",
+        f"slowest 10 of {len(_CALL_DURATIONS)} test calls "
+        f"(sum {total:.0f}s; non-slow tests >20s belong on the slow tier)",
+    )
+    for dur, nodeid in top:
+        terminalreporter.write_line(f"{dur:8.2f}s  {nodeid}")

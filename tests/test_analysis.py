@@ -472,3 +472,93 @@ def test_cli_clean_config_passes(tmp_path, capsys):
     assert rep["ok"] is True
     assert rep["geometry"]["aliased_buffers"] == rep["geometry"]["donated_leaves"]
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# cost report on the SERVING geometry (the tp=2 window's comms pattern —
+# what bench_serving --tp attaches to its record as serve_comms_by_axis)
+# ---------------------------------------------------------------------------
+
+# the serving audit mesh: tp=2,replica=2 (4 devices; replica is the
+# shared-nothing DP axis and must carry ZERO serving-dispatch traffic)
+SERVING_MESH = MeshInfo(
+    axis_names=("pipeline", "replica", "fsdp", "sequence", "tensor"),
+    axis_sizes=(1, 2, 1, 1, 2),
+)
+
+
+def test_cost_report_serving_tp2_two_psums_per_layer():
+    """Canned partitioned decode-window HLO: 2 layers x 2 activation-row
+    psums (row-parallel wo + w_down) + the vocab-argmax combiner, all
+    over 'tensor'. The per-axis attribution is hand-computed: an
+    all-reduce moves 2*(G-1)/G of its buffer, so each bf16[4,1,768] psum
+    is 2 * 6144 * 1/2 = 6144 wire bytes."""
+    analysis = StepAnalysis.from_text(
+        _fixture("serving_tp2_window.hlo"),
+        SERVING_MESH,
+        global_batch=4,
+        block=256,
+        donated_leaves=3,
+    )
+    rep = cost_report(analysis)
+    assert rep["collective_count"] == 5  # 2 psums/layer x 2 + combiner
+    psum = 2 * (4 * 1 * 768 * 2) // 2  # ring all-reduce, G=2
+    combiner = 2 * (4 * 4 + 4 * 4) // 2  # (f32[4], s32[4]) pair
+    assert rep["by_axis"] == {"tensor": 4 * psum + combiner}
+    assert "replica" not in rep["by_axis"]  # shared-nothing DP: silence
+    assert rep["value"] == 4 * psum + combiner
+    assert rep["dcn_bytes"] == 0  # serving meshes are single-slice
+    assert rep["by_kind"]["all-reduce"]["count"] == 5
+    assert all(c["medium"] == "ici" for c in rep["collectives"])
+
+
+def test_serving_tp2_fixture_passes_page_gather_rule():
+    """The same canned window against the no-batch-allgather-in-
+    page-gather rule: psums are not gathers, so the healthy pattern is
+    silent; adding one pool-payload all-gather trips it."""
+    from midgpt_tpu.analysis.rules import NoPageGatherAllGather
+
+    payload = frozenset({(2, 32, 12, 64, 16), (4, 12, 64, 256)})
+    text = _fixture("serving_tp2_window.hlo")
+    analysis = StepAnalysis.from_text(
+        text, SERVING_MESH, global_batch=4, block=256, donated_leaves=3
+    )
+    rule = NoPageGatherAllGather(payload, 4)
+    assert rule.check(analysis) == []
+    bad_line = (
+        "  regather = bf16[2,32,12,64,16]{4,3,2,1,0} all-gather("
+        "bf16[2,32,6,64,16]{4,3,2,1,0} %p1), replica_groups={{0,1},{2,3}}, "
+        "dimensions={2}\n"
+    )
+    bad = StepAnalysis.from_text(
+        text.replace("ENTRY main {\n", "ENTRY main {\n" + bad_line),
+        SERVING_MESH, global_batch=4, block=256, donated_leaves=3,
+    )
+    assert len(rule.check(bad)) == 1
+
+
+@pytest.mark.slow
+def test_compiled_tp2_window_comms_all_on_tensor():
+    """Compile the REAL tp=2 decode window (the exact call bench_serving
+    --tp makes for serve_comms_by_axis) and assert the cost report's
+    per-axis attribution: every wire byte crosses 'tensor' only — the
+    two-psums-per-layer contract on the live program."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    from midgpt_tpu.analysis.harness import compile_decode_window
+
+    cfg = get_config("openwebtext")
+    hlo, mesh, donated, block, _, _, _ = compile_decode_window(
+        cfg, slots=4, window=2, page_size=16, shrink=True,
+        mesh_shape={"tensor": 2},
+    )
+    analysis = StepAnalysis.from_text(
+        hlo, MeshInfo.from_mesh(mesh, num_slices=1),
+        global_batch=4, block=block, donated_leaves=donated,
+    )
+    rep = cost_report(analysis)
+    assert rep["collective_count"] > 0
+    assert set(rep["by_axis"]) == {"tensor"}
+    assert rep["dcn_bytes"] == 0

@@ -215,6 +215,146 @@ def test_decorated_state_fn_flagged():
 
 
 # ---------------------------------------------------------------------------
+# no-model-closure-jit (serving modules only)
+# ---------------------------------------------------------------------------
+
+
+def _lint_serving(src: str):
+    return lint_source(
+        textwrap.dedent(src), path="midgpt_tpu/serving/probe.py"
+    )
+
+
+_CLOSURE_SRC = """
+    import jax
+
+    def build(model):
+        def window_fn(pool, logits):
+            return model(pool), logits
+
+        return jax.jit(window_fn, donate_argnums=(0,))
+    """
+
+
+def test_model_closure_in_serving_flagged():
+    fs = _lint_serving(_CLOSURE_SRC)
+    assert ("no-model-closure-jit", 8) in _rules(fs)
+
+
+def test_model_closure_outside_serving_not_flagged():
+    """The rule is scoped to midgpt_tpu/serving/ — trainers may close
+    over config-derived structures."""
+    fs = lint_source(
+        textwrap.dedent(_CLOSURE_SRC), path="midgpt_tpu/train_probe.py"
+    )
+    assert [(r, n) for r, n in _rules(fs) if r == "no-model-closure-jit"] == []
+
+
+def test_model_as_parameter_passes():
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build():
+            def window_fn(model, pool, logits):
+                return model(pool), logits
+
+            return jax.jit(window_fn, donate_argnums=(1,))
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_model_closure_lambda_flagged():
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build(model, window_fn):
+            return jax.jit(lambda pool: window_fn(model, pool))
+        """
+    )
+    assert ("no-model-closure-jit", 5) in _rules(fs)
+
+
+def test_model_closure_decorator_flagged():
+    fs = _lint_serving(
+        """
+        import functools
+        import jax
+
+        def build(model):
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def window_fn(pool):
+                return model(pool)
+
+            return window_fn
+        """
+    )
+    assert ("no-model-closure-jit", 6) in _rules(fs)
+
+
+def test_model_closure_not_hidden_by_nested_local_binding():
+    """A nested helper that binds its OWN local `model` must not mask a
+    genuine capture by the jitted function (scope-aware free-variable
+    analysis — a flat bound set would swallow the real finding)."""
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build(model):
+            def window_fn(pool):
+                def helper(x):
+                    model = x * 2
+                    return model
+
+                return helper(pool) + model.wte
+
+            return jax.jit(window_fn)
+        """
+    )
+    assert any(r == "no-model-closure-jit" for r, _ in _rules(fs))
+
+
+def test_nested_def_model_parameter_not_flagged():
+    """A nested def whose PARAMETER is named model binds it in its own
+    scope — the jitted function captures nothing."""
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build():
+            def window_fn(pool):
+                def helper(model):
+                    return model + 1
+
+                return helper(pool)
+
+            return jax.jit(window_fn)
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_model_closure_waivable():
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build(model):
+            def warm_fn(pool):
+                return model(pool)
+
+            return jax.jit(warm_fn)  # shardlint: disable=no-model-closure-jit
+        """
+    )
+    assert _rules(fs) == []
+    assert any(
+        f.rule == "no-model-closure-jit" and f.waived for f in fs
+    )
+
+
+# ---------------------------------------------------------------------------
 # pragmas
 # ---------------------------------------------------------------------------
 

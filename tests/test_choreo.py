@@ -1,0 +1,389 @@
+"""Arithmetic-choreography prover tests (analysis/choreo.py).
+
+The prover must (a) PASS on the shipped tree — decode window, prefill
+chunk and verify program satisfy their documented dtype-choreography
+contracts — and (b) FAIL on both historical bug classes, injected as
+faulty attention variants:
+
+- the PR 4 bug: a chunk-prefill variant that upcasts to f32 before the
+  score einsums and keeps f32 probs through the PV contraction (the
+  "cast-early" drift that flipped near-tied greedy argmaxes on a real
+  checkpoint);
+- the PR 5 bug: a verify variant that reuses the PREFILL choreography
+  (bf16 score einsums, ``* scale``, probs rounded to the value dtype)
+  instead of mirroring the decode window's arithmetic.
+
+The faulty variants below copy the real methods' structure with exactly
+the historical arithmetic flipped, and are monkeypatched onto
+``Attention`` so the prover traces them through the REAL program
+factories — the same route a regression would take.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from midgpt_tpu.analysis.choreo import (
+    attention_regions,
+    extract_choreography,
+    flatten_jaxpr,
+    normalized_trace,
+)
+from midgpt_tpu.analysis.harness import prove_serving_choreography
+from midgpt_tpu.models.gpt import Attention
+from midgpt_tpu.parallel.sharding import shard_act
+from midgpt_tpu.serving import engine as engine_mod
+
+
+@pytest.fixture(scope="module")
+def healthy_report():
+    return prove_serving_choreography("openwebtext")
+
+
+def _checks(report):
+    return {c.name: c.ok for c in report.checks}
+
+
+# ---------------------------------------------------------------------------
+# the prover passes on the shipped tree
+# ---------------------------------------------------------------------------
+
+
+def test_prover_passes_on_current_tree(healthy_report):
+    assert healthy_report.ok, "\n".join(
+        f"{c.name}: {c.detail}"
+        for c in healthy_report.checks
+        if not c.ok
+    )
+
+
+def test_prover_passes_on_quant_path():
+    rep = prove_serving_choreography("openwebtext", quant=True)
+    assert rep.ok, "\n".join(
+        f"{c.name}: {c.detail}" for c in rep.checks if not c.ok
+    )
+    # the quantized lm head must carry the dequant epilogue in ALL
+    # three programs (a missing epilogue = wrong logits, an epilogue on
+    # some programs only = choreography drift)
+    for p in rep.programs:
+        if p.name != "naive_reference":
+            assert p.lm_head_epilogue, p.name
+
+
+def test_decode_and_verify_traces_are_op_identical(healthy_report):
+    progs = {p.name: p for p in healthy_report.programs}
+    assert progs["decode_window"].attention == progs["verify"].attention
+    # and the documented ASYMMETRY is real: the prefill chunk's probs
+    # round to the value dtype (naive contract) while decode keeps f32
+    assert progs["decode_window"].softmax.probs_dtype == {"float32"}
+    assert progs["prefill_chunk"].softmax.probs_dtype == {"bfloat16"}
+
+
+def test_report_serializes(healthy_report):
+    d = healthy_report.to_dict()
+    assert d["ok"] is True
+    assert set(d["programs"]) == {
+        "decode_window", "prefill_chunk", "verify", "naive_reference"
+    }
+
+
+# ---------------------------------------------------------------------------
+# flattener units
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_tracks_invar_origin_through_structural_ops():
+    def f(w, x):
+        # weight sliced + cast (the stacked-layer pattern) then matmul
+        wl = jnp.transpose(w[0]).astype(jnp.bfloat16)
+        return x @ wl
+
+    g = flatten_jaxpr(
+        jax.make_jaxpr(f)(
+            jnp.zeros((2, 4, 8)), jnp.zeros((3, 8), jnp.bfloat16)
+        )
+    )
+    dots = [op for op in g.ops if op.prim == "dot_general"]
+    assert len(dots) == 1
+    assert "invar" in dots[0].in_origins
+
+
+def test_flatten_recurses_into_jitted_calls():
+    @jax.jit
+    def inner(x):
+        return jax.nn.softmax(x)
+
+    def f(x):
+        return inner(x * 2.0)
+
+    g = flatten_jaxpr(jax.make_jaxpr(f)(jnp.zeros((4,), jnp.float32)))
+    prims = {op.prim for op in g.ops}
+    assert "exp" in prims and "reduce_max" in prims
+
+
+def test_attention_regions_one_per_layer(healthy_report):
+    for p in healthy_report.programs:
+        if p.name == "naive_reference":
+            continue
+        assert p.n_layers == 2  # the choreography-size trace depth
+
+
+def test_normalized_trace_drops_structure_keeps_dtypes():
+    def f(x):
+        y = jnp.transpose(x).reshape(-1)
+        return jnp.exp(y.astype(jnp.float32))
+
+    g = flatten_jaxpr(jax.make_jaxpr(f)(jnp.zeros((2, 3), jnp.bfloat16)))
+    trace = normalized_trace(g)
+    assert trace == [
+        ("convert_element_type", ("bfloat16",), ("float32",)),
+        ("exp", ("float32",), ("float32",)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# fault injection: the PR 4 bug (cast-early prefill chunk)
+# ---------------------------------------------------------------------------
+
+
+def _cast_early_prefill_paged_at(
+    self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
+    sin_rows, cos_rows,
+):
+    """prefill_paged_at with the HISTORICAL PR 4 drift re-injected:
+    f32 upcast before the score einsums and f32 probs through the PV
+    contraction (instead of mirroring naive_attention's bf16-operand /
+    f32-accumulate scores and value-dtype probs)."""
+    from midgpt_tpu.models.layers import apply_rotary
+
+    b, t, d = x.shape
+    h, hkv = self.n_head, self.n_kv_head
+    c = d // h
+    qkv = self.wqkv(x)
+    q = qkv[..., : h * c].reshape(b, t, h, c)
+    k = qkv[..., h * c : (h + hkv) * c].reshape(b, t, hkv, c)
+    v = qkv[..., (h + hkv) * c :].reshape(b, t, hkv, c)
+    if self.q_norm is not None:
+        q = self.q_norm(q)
+        k = self.k_norm(k)
+    q = jnp.transpose(q, (0, 2, 1, 3))
+    k = jnp.transpose(k, (0, 2, 1, 3))
+    v = jnp.transpose(v, (0, 2, 1, 3))
+    q = apply_rotary(q, sin_rows, cos_rows)
+    k = apply_rotary(k, sin_rows, cos_rows)
+    pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+    pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+    _, pmax, _, _, ps = pk_l.shape
+    ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    qg = q.reshape(b, hkv, h // hkv, t, c)
+    # THE BUG: cast-early scores (f32 multiply operands)
+    s_pool = jnp.einsum(
+        "bhgtc,bhcw->bhgtw",
+        qg.astype(jnp.float32), ck.astype(jnp.float32),
+    )
+    s_self = jnp.einsum(
+        "bhgtc,bhsc->bhgts",
+        qg.astype(jnp.float32), k.astype(jnp.float32),
+    )
+    s_all = jnp.concatenate(
+        [s_pool + mask_pool, s_self + mask_self], axis=-1
+    )
+    scale = 1.0 / jnp.sqrt(c).astype(jnp.float32)
+    probs = jax.nn.softmax(s_all * scale, axis=-1)
+    # THE BUG (cont.): f32 probs straight into the PV contraction
+    p_pool = probs[..., : s_pool.shape[-1]]
+    p_self = probs[..., s_pool.shape[-1]:]
+    o_pool = jnp.einsum(
+        "bhgtw,bhcw->bhgtc", p_pool, cv.astype(jnp.float32)
+    )
+    o_self = jnp.einsum(
+        "bhgts,bhsc->bhgtc", p_self, v.astype(jnp.float32)
+    )
+    out = (o_pool + o_self).reshape(b, h, t, c)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+    out = shard_act(out, None, None, "heads")
+    return self.wo(out.astype(x.dtype)), k, v
+
+
+def test_prover_catches_cast_early_prefill(monkeypatch):
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(
+        Attention, "prefill_paged_at", _cast_early_prefill_paged_at
+    )
+    try:
+        rep = prove_serving_choreography("openwebtext")
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks["prefill-mirrors-naive"] is False
+    # the decode/verify contract is untouched by a prefill fault
+    assert checks["verify-mirrors-decode"] is True
+
+
+# ---------------------------------------------------------------------------
+# fault injection: the PR 5 bug (prefill-choreography verify)
+# ---------------------------------------------------------------------------
+
+
+def _prefill_flavored_verify_paged_at(
+    self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
+    sin_rows, cos_rows,
+):
+    """verify_paged_at as PR 5's FIRST CUT wrote it: the prefill
+    chunk's choreography (bf16 score einsums with f32 accumulation,
+    ``* scale``, probs rounded to the value dtype, no cache-dtype
+    rounding of the in-dispatch self K/V) instead of the decode
+    window's. Flips near-tied acceptance argmaxes on bf16 checkpoints."""
+    from midgpt_tpu.models.layers import apply_rotary
+
+    b, t, d = x.shape
+    h, hkv = self.n_head, self.n_kv_head
+    c = d // h
+    qkv = self.wqkv(x)
+    q = qkv[..., : h * c].reshape(b, t, h, c)
+    k = qkv[..., h * c : (h + hkv) * c].reshape(b, t, hkv, c)
+    v = qkv[..., (h + hkv) * c :].reshape(b, t, hkv, c)
+    if self.q_norm is not None:
+        q = self.q_norm(q)
+        k = self.k_norm(k)
+    q = jnp.transpose(q, (0, 2, 1, 3))
+    k = jnp.transpose(k, (0, 2, 1, 3))
+    v = jnp.transpose(v, (0, 2, 1, 3))
+    q = apply_rotary(q, sin_rows, cos_rows)
+    k = apply_rotary(k, sin_rows, cos_rows)
+    pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+    pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+    _, pmax, _, _, ps = pk_l.shape
+    ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    qg = q.reshape(b, hkv, h // hkv, t, c)
+    # THE BUG: prefill-flavored scores (compute-dtype operands, f32
+    # accumulate) instead of the decode window's f32-upcast VPU form
+    s_pool = jnp.einsum(
+        "bhgtc,bhcw->bhgtw", qg, ck.astype(qg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    s_self = jnp.einsum(
+        "bhgtc,bhsc->bhgts", qg, k,
+        preferred_element_type=jnp.float32,
+    )
+    s_all = jnp.concatenate(
+        [s_pool + mask_pool, s_self + mask_self], axis=-1
+    )
+    scale = 1.0 / jnp.sqrt(c).astype(jnp.float32)
+    probs = jax.nn.softmax(s_all * scale, axis=-1)
+    # THE BUG (cont.): probs rounded to the value dtype before PV
+    probs = probs.astype(v.dtype)
+    p_pool = probs[..., : s_pool.shape[-1]]
+    p_self = probs[..., s_pool.shape[-1]:]
+    o_pool = jnp.einsum("bhgtw,bhcw->bhgtc", p_pool, cv.astype(v.dtype))
+    o_self = jnp.einsum("bhgts,bhsc->bhgtc", p_self, v)
+    out = (o_pool + o_self).reshape(b, h, t, c)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
+    out = shard_act(out, None, None, "heads")
+    return self.wo(out.astype(x.dtype)), k, v
+
+
+def test_prover_catches_prefill_flavored_verify(monkeypatch):
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(
+        Attention, "verify_paged_at", _prefill_flavored_verify_paged_at
+    )
+    try:
+        rep = prove_serving_choreography("openwebtext")
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks["verify-mirrors-decode"] is False
+    # the prefill/naive contract is untouched by a verify fault
+    assert checks["prefill-mirrors-naive"] is True
+
+
+# ---------------------------------------------------------------------------
+# fault injection: scale applied before the mask (ordering drift)
+# ---------------------------------------------------------------------------
+
+
+def _scale_before_mask_decode_paged_at(
+    self, x, pool_k, pool_v, bt, rk, rv, layer, r, mask_pool, mask_rec,
+    sin_rows, cos_rows,
+):
+    """decode_paged_at with the softmax argument order flipped: scores
+    are scaled BEFORE the additive mask lands, so the -inf mask is
+    divided too — a drift the shared-arithmetic check must flag even
+    though decode and verify would still agree with each other if both
+    drifted (which they don't here: only decode is patched, so the
+    op-for-op check fires first; the dedicated ordering check is what
+    fires when BOTH paths drift together)."""
+    b, one, d = x.shape
+    h, hkv = self.n_head, self.n_kv_head
+    c = d // h
+    q, k, v = self._decode_qkv(x, sin_rows, cos_rows)
+    zero = jnp.zeros((), r.dtype)
+    at = (jnp.asarray(layer, r.dtype), zero, zero, r, zero)
+    rk = jax.lax.dynamic_update_slice(rk, k.astype(rk.dtype)[None], at)
+    rv = jax.lax.dynamic_update_slice(rv, v.astype(rv.dtype)[None], at)
+    pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
+    pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
+    s_, pmax, _, _, ps = pk_l.shape
+    ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+    rkl, rvl = rk[layer], rv[layer]
+    qg = q.reshape(b, hkv, h // hkv, 1, c)
+    qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))
+    s_pool = jnp.sum(
+        qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
+        axis=-2,
+    )
+    s_rec = jnp.sum(
+        qg.astype(jnp.float32) * rkl[:, :, None].astype(jnp.float32),
+        axis=-1,
+    )
+    # THE BUG: scale first, then add the mask
+    s_all = jnp.concatenate(
+        [
+            s_pool / math.sqrt(c) + mask_pool[:, None, None, :],
+            s_rec / math.sqrt(c) + mask_rec,
+        ],
+        axis=-1,
+    )
+    probs = jax.nn.softmax(s_all, axis=-1)
+    p_pool = probs[..., : s_pool.shape[-1]]
+    p_rec = probs[..., s_pool.shape[-1]:]
+    o_pool = jnp.sum(
+        p_pool[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
+        axis=-1,
+    )
+    o_rec = jnp.sum(
+        p_rec[..., None] * rvl[:, :, None].astype(jnp.float32), axis=-2
+    )
+    out = (o_pool + o_rec).astype(x.dtype)
+    out = out.reshape(b, h, 1, c)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
+    return self.wo(out), rk, rv
+
+
+def test_prover_catches_scale_before_mask(monkeypatch):
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(
+        Attention, "decode_paged_at", _scale_before_mask_decode_paged_at
+    )
+    try:
+        rep = prove_serving_choreography("openwebtext")
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    # the patched decode drifts away from the (unpatched) verify, and
+    # the ordering invariant itself fires
+    assert (
+        checks["verify-mirrors-decode"] is False
+        or checks[
+            "shared: mask is added before the softmax scale everywhere"
+        ] is False
+    )
