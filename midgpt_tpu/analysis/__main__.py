@@ -150,6 +150,15 @@ def build_parser() -> argparse.ArgumentParser:
         "cannot mask a bf16 one)",
     )
     p.add_argument(
+        "--kv-quant", choices=("off", "on", "both"), default="off",
+        help="which KV-pool precisions the serving audits compile: "
+        "'on' stores the paged pool int8 with per-(page, KV-head) po2 "
+        "scales (serving.paged) — the budget cells gain a '-kv8' "
+        "precision suffix and the KV stream must land at ~half its "
+        "bf16 bytes; 'both' compiles each selected weight precision "
+        "with the float AND the int8 pool (default off)",
+    )
+    p.add_argument(
         "--mesh-shape", default=None, metavar="SPEC",
         help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
         "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
@@ -212,24 +221,42 @@ def _precisions(args) -> tp.Tuple[str, ...]:
     }[args.precision]
 
 
+def _kv_modes(args) -> tp.Tuple[bool, ...]:
+    return {
+        "off": (False,), "on": (True,), "both": (False, True),
+    }[args.kv_quant]
+
+
 def _run_choreo(args, cfg):
     """Run the choreography prover for the selected precisions; returns
     ``(per_precision_dicts, ok, violation_strings)`` — shared by the
     standalone ``--choreo`` mode and the ``--serving --choreo`` path."""
     from midgpt_tpu.analysis.harness import prove_serving_choreography
 
+    from midgpt_tpu.analysis.budgets import precision_key
+
     out: tp.Dict[str, tp.Any] = {}
     ok = True
     violations: tp.List[str] = []
     for precision in _precisions(args):
-        rep = prove_serving_choreography(cfg, quant=(precision == "int8"))
-        out[precision] = rep.to_dict()
-        ok = ok and rep.ok
-        violations.extend(
-            f"[choreo/{precision}] {c.name}: {c.detail}"
-            for c in rep.checks
-            if not c.ok
-        )
+        for kvq in _kv_modes(args):
+            # both paged-attention backends are proven per cell: the
+            # prover only TRACES (no compilation), so the Pallas kernel
+            # contract rides along at ~zero cost — the kernel body's
+            # softmax signature must equal the decode window's
+            for backend in ("xla", "pallas"):
+                rep = prove_serving_choreography(
+                    cfg, quant=(precision == "int8"), kv_quant=kvq,
+                    paged_kernel=backend,
+                )
+                tag = f"{precision_key(precision, kvq)}/{backend}"
+                out[tag] = rep.to_dict()
+                ok = ok and rep.ok
+                violations.extend(
+                    f"[choreo/{tag}] {c.name}: {c.detail}"
+                    for c in rep.checks
+                    if not c.ok
+                )
     return out, ok, violations
 
 
@@ -307,11 +334,16 @@ def _run_serving(args, cfg, mesh_shape) -> int:
     violations: tp.List[str] = []
     sections: tp.Dict[str, tp.Any] = {}
     budget_fragment: tp.Dict[tp.Tuple[str, str], tp.Any] = {}
+    from midgpt_tpu.analysis.budgets import precision_key
+
     for precision in precisions:
+      for kvq in _kv_modes(args):
+        pkey = precision_key(precision, kvq)
         for name, fn, kw, steps in program_specs:
             res = fn(
                 cfg, shrink=not args.no_shrink,
-                quant=(precision == "int8"), mesh_shape=mesh_shape,
+                quant=(precision == "int8"), kv_quant=kvq,
+                mesh_shape=mesh_shape,
                 traffic=args.traffic, **kw
             )
             analysis, report = res[0], res[1]
@@ -332,9 +364,9 @@ def _run_serving(args, cfg, mesh_shape) -> int:
 
                 traf = res[2]
                 section["traffic"] = traf.to_dict()
-                budget_fragment[(name, precision)] = traf
+                budget_fragment[(name, pkey)] = traf
                 budget = (
-                    budget_for(name, precision, budget_geom)
+                    budget_for(name, pkey, budget_geom)
                     if budget_geom
                     else None
                 )
@@ -353,7 +385,7 @@ def _run_serving(args, cfg, mesh_shape) -> int:
                         "ok": None,
                         "violations": [],
                     }
-            sections[f"{name}/{precision}"] = section
+            sections[f"{name}/{pkey}"] = section
 
     choreo_out = None
     if args.choreo:
@@ -365,6 +397,7 @@ def _run_serving(args, cfg, mesh_shape) -> int:
         "config": args.config,
         "mode": "serving-audit",
         "precisions": list(precisions),
+        "kv_quant": args.kv_quant,
         "ok": ok,
         "geometry": {
             "slots": args.serving_slots,

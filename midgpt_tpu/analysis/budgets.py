@@ -105,6 +105,62 @@ BUDGETS: tp.Dict[tp.Tuple[str, str, str], tp.Dict[str, int]] = {
         "weights": 8293888, "kv": 3145728, "logits": 8192,
         "constants_max": 262144, "comms_max": 829728,
     },
+    # --- int8-quantized KV pool (serving.paged kv_quant="int8"):
+    # payload halves (s8 pages) + 12,288 B of f32 per-(page, KV-head)
+    # scale planes join the KV stream — 3,158,016 = 6,291,456 / 2 +
+    # 12,288, i.e. the pool bytes serving decode streams per step drop
+    # to ~50.2% of the bf16 cells (asserted by tests/test_traffic.py).
+    # Regenerated with --kv-quant on; weight streams are untouched. ---
+    ("decode_window", "bf16-kv8", "single"): {
+        "weights": 31457792, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    ("prefill_chunk", "bf16-kv8", "single"): {
+        "weights": 31457792, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    ("verify_program", "bf16-kv8", "single"): {
+        "weights": 31457792, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    ("decode_window", "int8-kv8", "single"): {
+        "weights": 16574976, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    ("prefill_chunk", "int8-kv8", "single"): {
+        "weights": 16574976, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    ("verify_program", "int8-kv8", "single"): {
+        "weights": 16574976, "kv": 3158016, "logits": 16384,
+        "constants_max": 245760,
+    },
+    # --- tp=2,replica=2 x int8 KV: per-shard pool payload halves again
+    # (whole-KV-head sharding), scale planes shard with their heads ---
+    ("decode_window", "bf16-kv8", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 165936,
+    },
+    ("prefill_chunk", "bf16-kv8", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 2654208,
+    },
+    ("verify_program", "bf16-kv8", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 829728,
+    },
+    ("decode_window", "int8-kv8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 165936,
+    },
+    ("prefill_chunk", "int8-kv8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 2654208,
+    },
+    ("verify_program", "int8-kv8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 829728,
+    },
 }
 
 # band half-width for the exact streams: wide enough for layout/padding
@@ -112,6 +168,14 @@ BUDGETS: tp.Dict[tp.Tuple[str, str, str], tp.Dict[str, int]] = {
 # regression (one duplicated weight matrix: the [256, 1024] head, +5%
 # of the weight stream at this geometry) cannot hide inside it
 TOLERANCE = 0.04
+
+
+def precision_key(precision: str, kv_quant: bool = False) -> str:
+    """Budget-cell precision tag: the weight precision, suffixed
+    ``-kv8`` when the paged KV pool is int8-quantized (serving.paged) —
+    the pool payload halves and f32 per-(page, KV-head) scale planes
+    join the KV stream, so kv-quant cells are distinct budget rows."""
+    return f"{precision}-kv8" if kv_quant else precision
 
 
 def geometry_key(

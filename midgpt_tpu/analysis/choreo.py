@@ -109,10 +109,16 @@ class Op:
 
 
 class FlatGraph:
-    """Flattened jaxpr: linear op list + producer/consumer maps."""
+    """Flattened jaxpr: linear op list + producer/consumer maps.
+    ``kernels`` collects the body jaxprs of any Pallas kernel calls the
+    walk encountered (each appears in ``ops`` as one ``paged_kernel``
+    CONTRACT NODE rather than as inlined internals — the kernel body is
+    proven separately, see :func:`extract_choreography`)."""
 
-    def __init__(self, ops: tp.List[Op]):
+    def __init__(self, ops: tp.List[Op],
+                 kernels: tp.Optional[tp.List[tp.Any]] = None):
         self.ops = ops
+        self.kernels = kernels or []
         self.producer: tp.Dict[int, Op] = {}
         self.consumers: tp.Dict[int, tp.List[Op]] = {}
         for op in ops:
@@ -152,8 +158,42 @@ def flatten_jaxpr(closed) -> FlatGraph:
             env_[atom] = fresh("var")
         return env_[atom]
 
+    kernels: tp.List[tp.Any] = []
+
     def walk(jpr, env_) -> None:
         for eqn in jpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                # a Pallas kernel is ONE contract node in the outer
+                # trace: (operand dtypes, output dtypes). Its body is
+                # collected for the separate kernel-choreography proof
+                # rather than inlined — the internals are a different
+                # alphabet (refs, DMAs) and the contract the outer
+                # comparison needs is "same operands in, same dtype
+                # arithmetic inside, same dtype out".
+                kernels.append(eqn.params.get("jaxpr"))
+                ins = [read(env_, a) for a in eqn.invars]
+                in_d = tuple(
+                    str(getattr(a.aval, "dtype", "?")) for a in eqn.invars
+                )
+                out_d = tuple(
+                    str(getattr(v.aval, "dtype", "?"))
+                    for v in eqn.outvars
+                )
+                rec_outs = []
+                for ov in eqn.outvars:
+                    vid, _ = fresh("var")
+                    env_[ov] = (vid, "var")
+                    rec_outs.append(vid)
+                ops.append(Op(
+                    idx=len(ops),
+                    prim="paged_kernel",
+                    in_dtypes=in_d,
+                    out_dtypes=out_d,
+                    in_ids=tuple(vid for vid, _ in ins),
+                    out_ids=tuple(rec_outs),
+                    in_origins=tuple(origin for _, origin in ins),
+                ))
+                continue
             subs = [
                 p for p in eqn.params.values()
                 if hasattr(p, "eqns") or hasattr(p, "jaxpr")
@@ -217,7 +257,7 @@ def flatten_jaxpr(closed) -> FlatGraph:
             ))
 
     walk(jaxpr, env)
-    return FlatGraph(ops)
+    return FlatGraph(ops, kernels)
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +294,18 @@ def normalized_trace(graph: FlatGraph) -> tp.List[TraceRec]:
     the choreography contract is about dtypes and order, not widths)."""
     out: tp.List[TraceRec] = []
     for op in graph.ops:
+        if op.prim == "paged_kernel":
+            # the kernel contract node: float/int8 operand dtypes as a
+            # sorted multiset (decode and verify pass the same PAYLOADS
+            # — queries, row buffers, pool planes, scales — in different
+            # positional orders and with different integer plumbing;
+            # the contract is what dtypes cross the kernel boundary)
+            kept = tuple(sorted(
+                d for d in op.in_dtypes
+                if d in _FLOAT_DTYPES or d == "int8"
+            ))
+            out.append(("paged_kernel", kept, op.out_dtypes))
+            continue
         if op.prim not in _ARITH or not _is_float_op(op):
             continue
         kind = _dot_kind(op) if op.prim == "dot_general" else op.prim
@@ -279,7 +331,9 @@ def attention_regions(graph: FlatGraph) -> tp.List[tp.List[TraceRec]]:
             has_exp = False
             continue
         current.append(rec)
-        if rec[0] == "exp":
+        if rec[0] in ("exp", "paged_kernel"):
+            # a paged_kernel node IS the layer's joint softmax (the exp
+            # lives in the kernel body, proven separately)
             has_exp = True
     if has_exp:  # trailing region (no proj after — not the case today)
         regions.append(current)
@@ -492,6 +546,62 @@ def softmax_signature(
 # ---------------------------------------------------------------------------
 
 
+def _has_kv_dequant(graph: FlatGraph) -> bool:
+    """Does the graph multiply an int8-converted value — the
+    ``f32(codes) * scale`` dequant of an int8 KV pool? Distinguished
+    from the int8 WEIGHT path's epilogue by position: a weight's
+    ``convert(s8)`` feeds its dot_general and the epilogue multiplies
+    the DOT OUTPUT, while the KV dequant multiplies the converted codes
+    themselves (before any contraction)."""
+    for op in graph.ops:
+        if op.prim != "mul":
+            continue
+        for vid in op.in_ids:
+            v = vid
+            for _ in range(8):  # chase pass-through views
+                src = graph.producer.get(v)
+                if src is None:
+                    break
+                if src.prim == "convert_element_type":
+                    if src.in_dtypes[0] == "int8" and (
+                        src.out_dtypes[0] in _FLOAT_DTYPES
+                    ):
+                        return True
+                    break
+                if src.prim in _PASSTHRU or src.prim in (
+                    "gather", "dynamic_slice", "concatenate",
+                ):
+                    if not src.in_ids or src.in_ids[0] < 0:
+                        break
+                    v = src.in_ids[0]
+                    continue
+                break
+    return False
+
+
+def kernel_choreography(name: str, kernel_jaxpr) -> SoftmaxSignature:
+    """The softmax-core signature of a Pallas kernel BODY: the body is
+    ordinary jnp arithmetic over refs, so the very same extractor that
+    reads the XLA programs reads it — which is the point: the kernel's
+    contract (f32 score accumulation, mask before scale, f32 softmax,
+    f32 probs through PV) is proven by the same machinery that proved
+    the program it replaces, not by a parallel hand-written checklist."""
+    graph = flatten_jaxpr(kernel_jaxpr)
+    exps = [
+        op for op in graph.ops
+        if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+    ]
+    assert exps, f"{name}: kernel body contains no softmax exp"
+    sig = softmax_signature(graph, exps[0])
+    for e in exps[1:]:
+        s2 = softmax_signature(graph, e)
+        assert s2 == sig, (
+            f"{name}: kernel body softmax signatures differ:\n"
+            f"  {sig.describe()}\n  {s2.describe()}"
+        )
+    return sig
+
+
 @dataclasses.dataclass(frozen=True)
 class ProgramChoreography:
     """Everything the prover compares about one traced program."""
@@ -506,10 +616,24 @@ class ProgramChoreography:
     # dequant-epilogue multiply follows it
     lm_head: tp.Optional[TraceRec]
     lm_head_epilogue: bool
+    # True when the attention runs inside a Pallas kernel (the softmax
+    # signature above was extracted from the KERNEL BODY)
+    kernelized: bool = False
+    # the f32(s8-codes) * scale multiply of an int8 KV pool is present
+    # (in the kernel body or the gathered view)
+    kv_dequant: bool = False
 
 
 def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
-    """Normalize one traced program into its comparable choreography."""
+    """Normalize one traced program into its comparable choreography.
+
+    Programs whose attention runs in the Pallas paged kernel
+    (ops.paged_attn) carry the kernel call as ONE contract node in the
+    attention trace; the softmax signature is then extracted from the
+    KERNEL BODY (every per-layer body asserted identical), so the
+    decode-choreography contract is proven about the arithmetic the
+    kernel actually performs — a bf16-accumulating kernel variant turns
+    the same checks red that a bf16-accumulating XLA edit would."""
     graph = flatten_jaxpr(closed_jaxpr)
     regions = attention_regions(graph)
     assert regions, f"{name}: no attention softmax found in the trace"
@@ -519,17 +643,31 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
             f"{name}: layer {i}'s attention trace differs from layer 1 "
             f"— the stacked layers do not share one choreography"
         )
-    exps = [
-        op for op in graph.ops
-        if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
-    ]
-    sig = softmax_signature(graph, exps[0])
-    for e in exps[1:]:
-        s2 = softmax_signature(graph, e)
-        assert s2 == sig, (
-            f"{name}: softmax signatures differ between layers:\n"
-            f"  {sig.describe()}\n  {s2.describe()}"
+    kernels = [k for k in graph.kernels if k is not None]
+    kv_deq = _has_kv_dequant(graph)
+    if kernels:
+        sigs = {kernel_choreography(name, k) for k in kernels}
+        assert len(sigs) == 1, (
+            f"{name}: per-layer kernel bodies disagree:\n" + "\n".join(
+                s.describe() for s in sigs
+            )
         )
+        sig = next(iter(sigs))
+        kv_deq = kv_deq or any(
+            _has_kv_dequant(flatten_jaxpr(k)) for k in kernels
+        )
+    else:
+        exps = [
+            op for op in graph.ops
+            if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+        ]
+        sig = softmax_signature(graph, exps[0])
+        for e in exps[1:]:
+            s2 = softmax_signature(graph, e)
+            assert s2 == sig, (
+                f"{name}: softmax signatures differ between layers:\n"
+                f"  {sig.describe()}\n  {s2.describe()}"
+            )
     # lm head: the LAST weight projection in program order, plus its
     # epilogue (a following multiply whose other operand is an entry
     # parameter — the QuantLinear per-channel scale)
@@ -552,6 +690,8 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
         softmax=sig,
         lm_head=lm,
         lm_head_epilogue=epilogue,
+        kernelized=bool(kernels),
+        kv_dequant=kv_deq,
     )
 
 
@@ -593,6 +733,8 @@ class ChoreoReport:
                     "softmax": p.softmax.describe(),
                     "lm_head": list(p.lm_head) if p.lm_head else None,
                     "lm_head_epilogue": p.lm_head_epilogue,
+                    "kernelized": p.kernelized,
+                    "kv_dequant": p.kv_dequant,
                 }
                 for p in self.programs
             },
@@ -613,11 +755,18 @@ def prove_choreography(
     prefill: ProgramChoreography,
     verify: ProgramChoreography,
     naive: ProgramChoreography,
+    *,
+    expect_kv_dequant: bool = False,
 ) -> ChoreoReport:
     """Evaluate the three serving-choreography contracts (module
     docstring). ``naive`` is the reference trace of
     ``ops.attention.naive_attention`` — what the monolithic prefill (and
-    the training forward) computes."""
+    the training forward) computes. ``expect_kv_dequant`` (the int8 KV
+    pool): all three programs must carry the ``f32(codes) * scale``
+    dequant multiply of the quantized pool — a program reading raw codes
+    without its scale would be arithmetically wrong in a way no dtype
+    check sees, so presence of the scale-multiply is itself a proven
+    contract (and conversely, a float pool must NOT carry one)."""
     checks: tp.List[ChoreoCheck] = []
 
     # 1. verify mirrors decode OP FOR OP (the PR 5 contract)
@@ -657,6 +806,26 @@ def prove_choreography(
         sm == {"float32"},
         f"softmax dtypes {sorted(sm)}",
     ))
+    # extraction-degeneracy guard: a signature with NO score
+    # contractions or an unrecognized scale op means the program's
+    # softmax no longer has the shape the extractor (and the contract)
+    # expects — that is a violation, not a vacuous pass. Found by fault
+    # injection: a bf16-accumulating kernel variant used to slip through
+    # because jnp's silent re-promotion broke the score-chain walk and
+    # left every dtype set empty.
+    degenerate = {
+        p.name: (not p.softmax.qk_contracts, p.softmax.scale_op)
+        for p in progs
+    }
+    shared.append((
+        "every program exposes its score contractions to the prover",
+        all(
+            p.softmax.qk_contracts and p.softmax.scale_op in ("div", "mul")
+            and p.softmax.pv_contracts
+            for p in progs
+        ),
+        f"degenerate signatures: {degenerate}",
+    ))
     # PV accumulation is contract-specific (decode keeps f32 probs and
     # sums, the prefill chunk mirrors naive_attention's value-dtype
     # einsum) and is pinned per program by checks 1 and 2 — the SHARED
@@ -689,6 +858,29 @@ def prove_choreography(
         "all programs traced at one depth",
         len(layer_depths) == 1,
         f"layer counts {sorted(layer_depths)}",
+    ))
+    deq = {p.name: p.kv_dequant for p in progs}
+    if expect_kv_dequant:
+        shared.append((
+            "int8 KV: every program dequantizes the pool "
+            "(codes * per-page scale)",
+            all(deq.values()),
+            f"kv_dequant {deq}",
+        ))
+    else:
+        shared.append((
+            "float KV: no stray int8 pool dequant anywhere",
+            not any(deq.values()),
+            f"kv_dequant {deq}",
+        ))
+    # decode and verify must agree on WHERE the attention runs (both in
+    # the kernel or both in XLA) — a half-kernelized pair could pass the
+    # per-program checks while running two different arithmetic stacks
+    shared.append((
+        "decode and verify share one attention backend",
+        decode.kernelized == verify.kernelized,
+        f"kernelized decode={decode.kernelized} "
+        f"verify={verify.kernelized}",
     ))
     for name, ok, detail in shared:
         checks.append(ChoreoCheck(

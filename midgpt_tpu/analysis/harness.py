@@ -258,6 +258,7 @@ def serving_payload_shapes(
 def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
                          page_size: int, shrink: bool,
                          quant: bool = False,
+                         kv_quant: bool = False,
                          mesh_shape: tp.Optional[tp.Mapping[str, int]] = None):
     """Shared geometry for the three serving audits (decode window +
     prefill chunk + speculative verify): audit-shrunk model config,
@@ -332,7 +333,8 @@ def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
             model, param_shardings(mesh, model, GPT_PARAM_RULES)
         )
         pool = PagedKVPool.init(
-            model_cfg, slots * pmax, page_size, mesh=mesh
+            model_cfg, slots * pmax, page_size, mesh=mesh,
+            kv_quant="int8" if kv_quant else None,
         )
         logits = jax.device_put(
             jnp.zeros((slots, model_cfg.vocab_size), jnp.float32),
@@ -340,7 +342,10 @@ def _serving_audit_setup(cfg: ExperimentConfig, *, slots: int,
         )
         prog_mesh = mesh
     else:
-        pool = PagedKVPool.init(model_cfg, slots * pmax, page_size)
+        pool = PagedKVPool.init(
+            model_cfg, slots * pmax, page_size,
+            kv_quant="int8" if kv_quant else None,
+        )
         logits = jnp.zeros((slots, model_cfg.vocab_size), jnp.float32)
     wshapes: tp.FrozenSet[tp.Tuple[int, ...]] = frozenset()
     if quant:
@@ -439,6 +444,7 @@ def compile_decode_window(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's fused K-step decode window
@@ -468,7 +474,7 @@ def compile_decode_window(
     model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=slots, page_size=page_size, shrink=shrink,
-            quant=quant, mesh_shape=mesh_shape,
+            quant=quant, kv_quant=kv_quant, mesh_shape=mesh_shape,
         )
     )
     window_fn = make_decode_window(
@@ -507,6 +513,7 @@ def audit_decode_window(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -523,7 +530,8 @@ def audit_decode_window(
     hlo, mesh, donated, block, wshapes, payload, keys = (
         compile_decode_window(
             cfg, slots=slots, window=window, page_size=page_size,
-            shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+            shrink=shrink, quant=quant, kv_quant=kv_quant,
+            mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -548,6 +556,7 @@ def compile_prefill_chunk(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's prefill-chunk program
@@ -572,7 +581,7 @@ def compile_prefill_chunk(
     model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=4, page_size=page_size, shrink=shrink, quant=quant,
-            mesh_shape=mesh_shape,
+            kv_quant=kv_quant, mesh_shape=mesh_shape,
         )
     )
     assert chunk_len <= model_cfg.block_size, (chunk_len, model_cfg.block_size)
@@ -608,6 +617,7 @@ def audit_prefill_chunk(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -625,7 +635,7 @@ def audit_prefill_chunk(
     hlo, mesh, donated, block, wshapes, payload, keys = (
         compile_prefill_chunk(
             cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
-            quant=quant, mesh_shape=mesh_shape,
+            quant=quant, kv_quant=kv_quant, mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -651,6 +661,7 @@ def compile_verify_program(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's speculative VERIFY program
@@ -676,7 +687,7 @@ def compile_verify_program(
     model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=slots, page_size=page_size, shrink=shrink,
-            quant=quant, mesh_shape=mesh_shape,
+            quant=quant, kv_quant=kv_quant, mesh_shape=mesh_shape,
         )
     )
     verify_fn = make_verify_program(
@@ -713,6 +724,7 @@ def audit_verify_program(
     page_size: int = 16,
     shrink: bool = True,
     quant: bool = False,
+    kv_quant: bool = False,
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -729,7 +741,8 @@ def audit_verify_program(
     hlo, mesh, donated, block, wshapes, payload, keys = (
         compile_verify_program(
             cfg, slots=slots, spec_len=spec_len, page_size=page_size,
-            shrink=shrink, quant=quant, mesh_shape=mesh_shape,
+            shrink=shrink, quant=quant, kv_quant=kv_quant,
+            mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -756,6 +769,8 @@ def prove_serving_choreography(
     chunk_len: int = 16,
     page_size: int = 16,
     quant: bool = False,
+    kv_quant: bool = False,
+    paged_kernel: str = "xla",
 ):
     """Run the arithmetic-choreography prover
     (:mod:`midgpt_tpu.analysis.choreo`) over the three serving programs
@@ -773,8 +788,15 @@ ChoreoReport`.
     contract is per-layer-identical by construction (asserted by the
     extractor), so depth and width add nothing but trace time. No
     compilation happens — a full proof is seconds on CPU. ``quant``
-    proves the int8 path instead (same contracts; the lm-head check
-    additionally pins the dequant epilogue everywhere)."""
+    proves the int8 WEIGHT path instead (same contracts; the lm-head
+    check additionally pins the dequant epilogue everywhere).
+    ``kv_quant`` traces the programs against an int8 KV pool and
+    additionally proves every program carries the pool's
+    codes-times-scale dequant. ``paged_kernel="pallas"`` traces the
+    Pallas ragged-walk programs: the kernel appears as one contract
+    node in the attention traces and its BODY's softmax signature is
+    what the decode/verify checks then compare — a bf16-accumulating
+    kernel variant fails exactly like a bf16-accumulating XLA edit."""
     import dataclasses as _dc
 
     import jax
@@ -808,6 +830,7 @@ ChoreoReport`.
     jaxprs = trace_serving_programs(
         model, slots=slots, window=window, spec_len=spec_len,
         chunk_len=chunk_len, page_size=page_size,
+        kv_quant="int8" if kv_quant else None, paged_kernel=paged_kernel,
     )
 
     # the naive reference: what the monolithic prefill / training
@@ -834,6 +857,7 @@ ChoreoReport`.
         prefill=extract_choreography("prefill_chunk", jaxprs["prefill_chunk"]),
         verify=extract_choreography("verify", jaxprs["verify"]),
         naive=extract_choreography("naive_reference", naive_jaxpr),
+        expect_kv_dequant=kv_quant,
     )
 
 

@@ -249,7 +249,9 @@ def floor_decomposition(
     slots: int,
     live_tokens: tp.Optional[float] = None,
     quant: bool = False,
+    kv_quant: bool = False,
     cache_bytes: int = 2,
+    page_size: int = 16,
     hbm_gbps: float = 800.0,
     tp_degree: int = 1,
 ) -> tp.Dict[str, tp.Any]:
@@ -259,14 +261,25 @@ def floor_decomposition(
     (the fully-grown worst case); pass a trace mean for a workload
     floor. Under TP the weight and KV streams are per-CHIP (1/tp each
     — column/row-parallel weights, whole-KV-head pool sharding); the
-    cross-chip wire bytes are cost_report territory, not HBM."""
+    cross-chip wire bytes are cost_report territory, not HBM.
+    ``kv_quant`` prices the int8 paged pool: 1-byte K/V elements plus
+    the f32 per-(page, KV-head) scale planes of the live pages (one
+    f32 per plane per K and V — ``page_size`` sets how many positions
+    share a scale)."""
     live = float(
         cfg.block_size if live_tokens is None else live_tokens
     )
     w = weight_stream_bytes(cfg, quant=quant) // tp_degree
+    kv_bytes = 1 if kv_quant else cache_bytes
     kv = kv_stream_bytes(
-        cfg, slots=slots, live_tokens=live, cache_bytes=cache_bytes
+        cfg, slots=slots, live_tokens=live, cache_bytes=kv_bytes
     ) // tp_degree
+    if kv_quant:
+        # per-page dequant scales: live pages x KV heads x f32, K and V
+        live_pages = -(-int(live) // page_size)
+        kv += (
+            cfg.n_layer * slots * live_pages * cfg.kv_heads * 4 * 2
+        ) // tp_degree
     # the carried [S, V] f32 logits are read (sampling) and written
     # (carry) once per step; vocab-sharded under TP
     logits = 2 * slots * cfg.vocab_size * 4 // tp_degree
@@ -276,6 +289,7 @@ def floor_decomposition(
         "slots": slots,
         "live_tokens": live,
         "quant": quant,
+        "kv_quant": kv_quant,
         "tp": tp_degree,
         "hbm_gbps": hbm_gbps,
         "weights_bytes_per_step": w,
@@ -303,6 +317,7 @@ def floor_table_markdown(rows: tp.Sequence[tp.Dict[str, tp.Any]]) -> str:
         geom = (
             f"B={r['slots']} live={int(r['live_tokens'])}"
             f"{' int8' if r['quant'] else ' bf16'}"
+            + (" kv8" if r.get("kv_quant") else "")
             + (f" tp={r['tp']}" if r.get("tp", 1) > 1 else "")
         )
         lines.append(

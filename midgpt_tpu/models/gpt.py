@@ -99,6 +99,108 @@ def _fused_attention_sharded(qkv, wq, wk, sin, cos, h, hkv, eps):
     )(q, k, v, wq, wk, sin, cos)
 
 
+def _gathered_pool_view(pool_x, scale_x, bt, layer):
+    """The block-table gather: the slots' pages as a logical KV view
+    ``[S, Hkv, C, W = Pmax*PS]`` in page order. Float pools return the
+    gathered pages in POOL dtype — byte-for-byte the pre-existing path
+    (downstream ``.astype(f32)`` upcasts are where the choreography
+    fixes the arithmetic). Int8 pools dequantize at the view:
+    ``f32(codes) * scale`` with the per-(page, KV-head) po2 scale
+    broadcast to its page's columns — EXACT (|code| <= 127, po2 scale;
+    midgpt_tpu.quant's KV grid contract), so every downstream consumer
+    sees precisely the grid values a bf16 pool would have held.
+    ``mode="clip"``, NOT "fill": block-table pads carry the out-of-range
+    sentinel, and fill-mode NaNs would poison the score sum straight
+    through the additive mask (0 * NaN = NaN); clipped garbage is erased
+    by the -inf mask before the softmax."""
+    pk_l = jnp.take(pool_x[layer], bt, axis=0, mode="clip")
+    s_, pmax, hkv, c, ps = pk_l.shape
+    ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(s_, hkv, c, pmax * ps)
+    if scale_x is None:
+        return ck
+    sc = jnp.take(scale_x[layer], bt, axis=0, mode="clip")  # [S, Pmax, Hkv]
+    scw = jnp.transpose(sc, (0, 2, 1))[:, :, None, :, None]
+    scw = jnp.broadcast_to(
+        scw, (s_, hkv, 1, pmax, ps)
+    ).reshape(s_, hkv, 1, pmax * ps)
+    return ck.astype(jnp.float32) * scw
+
+
+def _gathered_pool_scales(scale_x, bt, layer):
+    """Per-slot per-page scale gather ``[S, Pmax, Hkv]`` for the Pallas
+    kernels (which dequantize in-kernel and only need the tiny scale
+    planes gathered, never the payload)."""
+    if scale_x is None:
+        return None
+    return jnp.take(scale_x[layer], bt, axis=0, mode="clip")
+
+
+def _paged_kernel_dispatch(kind: str, layer: int, tensors, scales):
+    """Run a serving paged-attention kernel (ops.paged_attn), wrapped in
+    ``shard_map`` under a live TP mesh: a bare ``pallas_call`` is an
+    opaque custom call, and GSPMD would gather the KV-head-sharded pool
+    onto every device (the same trap ``_fused_attention_sharded``
+    documents). Each shard runs the kernel on its own Hkv/tp heads —
+    the pool's page/time dims stay whole per shard, block tables and
+    lengths ride replicated, so the walk is shard-local exactly like
+    the XLA gather it replaces."""
+    from midgpt_tpu.ops.paged_attn import (
+        paged_decode_attention,
+        paged_verify_attention,
+    )
+
+    mesh = current_mesh()
+    tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+    quant = scales[0] is not None
+    sc = tuple(scales) if quant else ()
+
+    if kind == "decode":
+        q, pool_k, pool_v, bt, pooled_len, rkl, rvl, r = tensors
+        call = lambda *a: paged_decode_attention(  # noqa: E731
+            a[0], a[1], a[2], a[3], a[4], a[5], a[6], a[7], layer,
+            *(a[8:] or (None, None)),
+        )
+        specs = [
+            ("tensor", 1), ("tensor", 2), ("tensor", 2), (None, None),
+            (None, None), ("tensor", 1), ("tensor", 1), (None, None),
+        ]
+    else:
+        q, kc, vc, pool_k, pool_v, bt, start = tensors
+        call = lambda *a: paged_verify_attention(  # noqa: E731
+            a[0], a[1], a[2], a[3], a[4], a[5], a[6], layer,
+            *(a[7:] or (None, None)),
+        )
+        specs = [
+            ("tensor", 1), ("tensor", 1), ("tensor", 1), ("tensor", 2),
+            ("tensor", 2), (None, None), (None, None),
+        ]
+    args = tuple(tensors) + sc
+    if mesh is None or tp == 1:
+        return call(*args)
+
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(arr, axis_pos):
+        name, pos = axis_pos
+        if name is None:
+            return P(*([None] * arr.ndim))
+        entries = [None] * arr.ndim
+        entries[pos] = name
+        return P(*entries)
+
+    if quant:
+        specs = specs + [("tensor", 2), ("tensor", 2)]  # [S, Pmax, Hkv]
+    in_specs = tuple(spec_for(a, sp) for a, sp in zip(args, specs))
+    out_spec = P(None, "tensor", *([None] * (args[0].ndim - 2)))
+    return shard_map(
+        call,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        check_vma=False,
+    )(*args)
+
+
 @module
 class Attention:
     """Causal self-attention with QK-norm + RoPE (parity: model.py:34-81)."""
@@ -426,6 +528,10 @@ class Attention:
         mask_rec: Array,  # [R] additive f32 over recent rows
         sin_rows: Array,  # [S, 1, 1, C//2] per-slot rope rows (positions differ)
         cos_rows: Array,
+        pooled_len: tp.Optional[Array] = None,  # [S] int32 (kernel / kv-quant)
+        pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+        pool_sv: tp.Optional[Array] = None,
+        paged_kernel: str = "xla",
     ) -> tp.Tuple[Array, Array, Array]:
         """Single-token attention against a PAGED KV pool read through
         per-slot block tables, plus the write-combining recent buffer.
@@ -440,7 +546,21 @@ class Attention:
         (PERF.md r4 'Serving': per-token scattered column writes into the
         big time-minor cache either flip its layout or pay scattered RMW).
         Positions differ PER SLOT (continuous batching mixes requests at
-        different depths), hence per-slot rope rows and a [S, W] mask."""
+        different depths), hence per-slot rope rows and a [S, W] mask.
+
+        ``paged_kernel="pallas"`` replaces the gather + two-part softmax
+        with the ragged Pallas kernel (ops.paged_attn): the block table
+        is walked IN-KERNEL over each slot's ``pooled_len``, pages
+        stream from HBM exactly once, and no ``[S, Pmax*PS, ...]``
+        gathered intermediate exists — BITWISE the same result (the
+        kernel mirrors this method's op sequence; tested). An int8 pool
+        (``pool_sk``/``pool_sv`` given) dequantizes per (page, KV-head)
+        po2 scale — in-kernel on the kernel path, at the gathered view
+        here — and this step's K/V row is rounded through its target
+        page's grid BEFORE the recent buffer sees it, so in-window reads
+        and post-flush pool reads of the same position are
+        indistinguishable (the invariance the token-identity matrix
+        rests on)."""
         b, one, d = x.shape
         h, hkv = self.n_head, self.n_kv_head
         c = d // h
@@ -452,56 +572,101 @@ class Attention:
         q = shard_act(q, None, "heads", None, None)
         k = shard_act(k, None, "kv_heads", None, None)
         v = shard_act(v, None, "kv_heads", None, None)
+        quant = pool_sk is not None
+        ps = pool_k.shape[-1]
         zero = jnp.zeros((), r.dtype)
+        if quant:
+            # round this step's row through its page's int8 grid before
+            # ANY reader (the recent buffer, this very step's scores)
+            # sees it. The page scale: derived from this row when the
+            # page is born at this position, from the page's in-window
+            # birth row (already rounded — derivation is rounding-
+            # stable) when born earlier in this window, else the pool's
+            # recorded scale.
+            from midgpt_tpu.quant import round_kv_rows_to_grid
+            from midgpt_tpu.serving.paged import kv_row_scales
+
+            # the scale-derivation rows enter in COMPUTE dtype, exactly
+            # like verify's candidate rows: the recent buffer's bf16
+            # grid values upcast exactly, and matching operand dtypes
+            # keep the decode and verify attention traces op-identical
+            # (the choreography prover compares them record for record)
+            at4 = (zero, zero, r, zero)
+            tmp_k = jax.lax.dynamic_update_slice(
+                rk[layer].astype(k.dtype), k, at4
+            )
+            tmp_v = jax.lax.dynamic_update_slice(
+                rv[layer].astype(v.dtype), v, at4
+            )
+            sk_all, sv_all = kv_row_scales(
+                tmp_k, tmp_v, pooled_len, bt, pool_sk[layer],
+                pool_sv[layer], ps,
+            )  # [S, Hkv, R]
+            sk_r = jax.lax.dynamic_slice_in_dim(sk_all, r, 1, axis=2)
+            sv_r = jax.lax.dynamic_slice_in_dim(sv_all, r, 1, axis=2)
+            k = round_kv_rows_to_grid(k, sk_r)  # [S, Hkv, 1, C]
+            v = round_kv_rows_to_grid(v, sv_r)
         at = (jnp.asarray(layer, r.dtype), zero, zero, r, zero)
         rk = jax.lax.dynamic_update_slice(rk, k.astype(rk.dtype)[None], at)
         rv = jax.lax.dynamic_update_slice(rv, v.astype(rv.dtype)[None], at)
-        # gather this layer's pages through the block tables: the slot's
-        # logical KV [S, Hkv, C, W] in page order. mode="clip", NOT the
-        # default "fill": block-table pads carry the out-of-range sentinel,
-        # and fill-mode NaNs would poison the score sum straight through
-        # the additive mask (0 * NaN = NaN); clipped garbage is erased by
-        # mask_pool's -inf before the softmax.
-        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
-        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
-        s_, pmax, _, _, ps = pk_l.shape
-        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
-        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
-        # the block-table gather indexes the (replicated) page dim of a
-        # KV-head-sharded pool, so it is shard-local: each device gathers
-        # its own heads' pages. Pin the gathered view so the partitioner
-        # can never "help" by regathering heads (the batch-allgather
-        # footgun the no-batch-allgather-in-page-gather audit rule gates).
-        ck = shard_act(ck, None, "kv_heads", None, None)
-        cv = shard_act(cv, None, "kv_heads", None, None)
         rk = shard_act(rk, None, None, "kv_heads", None, None)
         rv = shard_act(rv, None, None, "kv_heads", None, None)
         rkl, rvl = rk[layer], rv[layer]  # [S, Hkv, R, C]
-        qg = q.reshape(b, hkv, h // hkv, 1, c)
-        qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [S, Hkv, G, C, 1]
-        s_pool = jnp.sum(
-            qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
-            axis=-2,
-        )  # [S, Hkv, G, W]
-        s_rec = jnp.sum(
-            qg.astype(jnp.float32) * rkl[:, :, None].astype(jnp.float32),
-            axis=-1,
-        )  # [S, Hkv, G, R]
-        s_all = jnp.concatenate(
-            [s_pool + mask_pool[:, None, None, :], s_rec + mask_rec], axis=-1
-        )
-        probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)
-        p_pool = probs[..., : s_pool.shape[-1]]
-        p_rec = probs[..., s_pool.shape[-1]:]
-        o_pool = jnp.sum(
-            p_pool[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
-            axis=-1,
-        )  # [S, Hkv, G, C]
-        o_rec = jnp.sum(
-            p_rec[..., None] * rvl[:, :, None].astype(jnp.float32), axis=-2
-        )
-        out = (o_pool + o_rec).astype(x.dtype)
-        out = out.reshape(b, h, 1, c)
+        if paged_kernel == "pallas":
+            # the ragged in-kernel block-table walk (ops.paged_attn):
+            # bitwise this method's arithmetic, none of its HBM gather
+            qs = shard_act(
+                q.reshape(b, hkv, h // hkv, c), None, "kv_heads", None, None
+            )
+            out = _paged_kernel_dispatch(
+                "decode", layer,
+                (qs, pool_k, pool_v, bt, pooled_len, rkl, rvl, r),
+                (_gathered_pool_scales(pool_sk, bt, layer),
+                 _gathered_pool_scales(pool_sv, bt, layer)),
+            )  # [S, Hkv, G, C]
+            out = shard_act(out, None, "kv_heads", None, None)
+            out = out.reshape(b, h, 1, c)
+        else:
+            # gather this layer's pages through the block tables: the
+            # slot's logical KV [S, Hkv, C, W] in page order (int8 pools
+            # dequantize at the view — see _gathered_pool_view)
+            ck = _gathered_pool_view(pool_k, pool_sk, bt, layer)
+            cv = _gathered_pool_view(pool_v, pool_sv, bt, layer)
+            # the block-table gather indexes the (replicated) page dim of
+            # a KV-head-sharded pool, so it is shard-local: each device
+            # gathers its own heads' pages. Pin the gathered view so the
+            # partitioner can never "help" by regathering heads (the
+            # batch-allgather footgun the
+            # no-batch-allgather-in-page-gather audit rule gates).
+            ck = shard_act(ck, None, "kv_heads", None, None)
+            cv = shard_act(cv, None, "kv_heads", None, None)
+            qg = q.reshape(b, hkv, h // hkv, 1, c)
+            qcw = jnp.transpose(qg, (0, 1, 2, 4, 3))  # [S, Hkv, G, C, 1]
+            s_pool = jnp.sum(
+                qcw.astype(jnp.float32) * ck[:, :, None].astype(jnp.float32),
+                axis=-2,
+            )  # [S, Hkv, G, W]
+            s_rec = jnp.sum(
+                qg.astype(jnp.float32) * rkl[:, :, None].astype(jnp.float32),
+                axis=-1,
+            )  # [S, Hkv, G, R]
+            s_all = jnp.concatenate(
+                [s_pool + mask_pool[:, None, None, :], s_rec + mask_rec],
+                axis=-1,
+            )
+            probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)
+            p_pool = probs[..., : s_pool.shape[-1]]
+            p_rec = probs[..., s_pool.shape[-1]:]
+            o_pool = jnp.sum(
+                p_pool[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
+                axis=-1,
+            )  # [S, Hkv, G, C]
+            o_rec = jnp.sum(
+                p_rec[..., None] * rvl[:, :, None].astype(jnp.float32),
+                axis=-2,
+            )
+            out = (o_pool + o_rec).astype(x.dtype)
+            out = out.reshape(b, h, 1, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, h * c)
         # merged [.., H*C] stays head-contiguous tensor-sharded: wo is
         # row-parallel (GPT_PARAM_RULES), so the contraction runs on
@@ -520,6 +685,9 @@ class Attention:
         mask_self: Array,  # [T, T] additive causal f32 within the chunk
         sin_rows: Array,  # [T, C//2] rope rows at the chunk's positions
         cos_rows: Array,
+        start: tp.Optional[Array] = None,  # [] int32 (kv-quant only)
+        pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+        pool_sv: tp.Optional[Array] = None,
     ) -> tp.Tuple[Array, Array, Array]:
         """Multi-query attention for a PREFILL CHUNK over a pre-populated
         block table: the chunk's T tokens attend jointly to the slot's
@@ -569,13 +737,32 @@ class Attention:
         q = shard_act(q, None, "heads", None, None)
         k = shard_act(k, None, "kv_heads", None, None)
         v = shard_act(v, None, "kv_heads", None, None)
+        if pool_sk is not None:
+            # int8 pool: round the chunk's own K/V rows through their
+            # target pages' grids BEFORE the in-chunk self-attention.
+            # Without this, a later chunk would read these positions
+            # from the pool (grid values) while the monolithic prefill
+            # read them in-chunk un-rounded — chunked vs monolithic
+            # streams would diverge under kv-quant. With it, every
+            # reader of a position sees one value, whatever the chunk
+            # grid. (The bf16 pool keeps the naive-attention contract
+            # un-rounded — rounding there is the identity at serving
+            # dtype, and the choreography prover pins that path.)
+            from midgpt_tpu.quant import round_kv_rows_to_grid
+            from midgpt_tpu.serving.paged import kv_row_scales
+
+            ps_ = pool_k.shape[-1]
+            sk_all, sv_all = kv_row_scales(
+                k, v, jnp.reshape(start, (1,)).astype(jnp.int32), bt,
+                pool_sk[layer], pool_sv[layer], ps_,
+            )  # [1, Hkv, T]
+            k = round_kv_rows_to_grid(k, sk_all)
+            v = round_kv_rows_to_grid(v, sv_all)
         # gather the slot's pages (clip-mode for the same NaN reason as
-        # decode_paged_at) -> logical KV [1, Hkv, C, W] in page order
-        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
-        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
-        _, pmax, _, _, ps = pk_l.shape
-        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
-        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
+        # decode_paged_at) -> logical KV [1, Hkv, C, W] in page order;
+        # int8 pools dequantize at the view (_gathered_pool_view)
+        ck = _gathered_pool_view(pool_k, pool_sk, bt, layer)
+        cv = _gathered_pool_view(pool_v, pool_sv, bt, layer)
         ck = shard_act(ck, None, "kv_heads", None, None)
         cv = shard_act(cv, None, "kv_heads", None, None)
         qg = q.reshape(b, hkv, h // hkv, t, c)
@@ -614,6 +801,10 @@ class Attention:
         mask_self: Array,  # [T, T] additive causal f32 within the rows
         sin_rows: Array,  # [S, 1, T, C//2] per-slot rope rows
         cos_rows: Array,
+        start: tp.Optional[Array] = None,  # [S] int32 (kernel / kv-quant)
+        pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+        pool_sv: tp.Optional[Array] = None,
+        paged_kernel: str = "xla",
     ) -> tp.Tuple[Array, Array, Array]:
         """Multi-query attention for SPECULATIVE VERIFICATION: all T
         candidate rows of every slot attend jointly to the slot's
@@ -658,15 +849,26 @@ class Attention:
         q = shard_act(q, None, "heads", None, None)
         k = shard_act(k, None, "kv_heads", None, None)
         v = shard_act(v, None, "kv_heads", None, None)
-        # gather the slots' pages (clip-mode for the same NaN reason as
-        # decode_paged_at) -> logical KV [S, Hkv, C, W] in page order
-        pk_l = jnp.take(pool_k[layer], bt, axis=0, mode="clip")
-        pv_l = jnp.take(pool_v[layer], bt, axis=0, mode="clip")
-        _, pmax, _, _, ps = pk_l.shape
-        ck = jnp.transpose(pk_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
-        cv = jnp.transpose(pv_l, (0, 2, 3, 1, 4)).reshape(b, hkv, c, pmax * ps)
-        ck = shard_act(ck, None, "kv_heads", None, None)
-        cv = shard_act(cv, None, "kv_heads", None, None)
+        quant = pool_sk is not None
+        ps = pool_k.shape[-1]
+        row_dt = jnp.bfloat16 if pool_k.dtype == jnp.int8 else pool_k.dtype
+        if quant:
+            # round the candidate rows through their target pages' int8
+            # grids: the verify self-reads, the decode window's recent-
+            # buffer reads, and the post-flush pool reads of the same
+            # positions must all see the identical grid values, or
+            # near-tied acceptance argmaxes flip between spec-on and
+            # spec-off (the PR 5 bug class, int8 edition). Rows past the
+            # watermark never land (flush mask) — rounding them is
+            # harmless.
+            from midgpt_tpu.quant import round_kv_rows_to_grid
+            from midgpt_tpu.serving.paged import kv_row_scales
+
+            sk_all, sv_all = kv_row_scales(
+                k, v, start, bt, pool_sk[layer], pool_sv[layer], ps
+            )  # [S, Hkv, T]
+            k = round_kv_rows_to_grid(k, sk_all)
+            v = round_kv_rows_to_grid(v, sv_all)
         qg = q.reshape(b, hkv, h // hkv, t, c)  # [S, Hkv, G, T, C]
         # the decode window stores each step's K/V into the CACHE-dtype
         # recent buffer and reads it back for the in-window scores — so
@@ -674,37 +876,58 @@ class Attention:
         # (an identity when cache dtype == compute dtype, but an f32
         # model over a bf16 pool would otherwise score un-rounded self
         # keys and flip near-tied acceptance argmaxes)
-        kc = k.astype(pool_k.dtype)
-        vc = v.astype(pool_v.dtype)
-        # scores as f32 broadcast-multiply + reduce, exactly the decode
-        # VPU form — q upcast first, cache upcast first, sum over C
-        s_pool = jnp.sum(
-            qg[..., :, None].astype(jnp.float32)
-            * ck[:, :, None, None].astype(jnp.float32),
-            axis=-2,
-        )  # [S, Hkv, G, T, W]
-        s_self = jnp.sum(
-            qg[:, :, :, :, None, :].astype(jnp.float32)
-            * kc[:, :, None, None].astype(jnp.float32),
-            axis=-1,
-        )  # [S, Hkv, G, T, T]
-        s_all = jnp.concatenate(
-            [s_pool + mask_pool, s_self + mask_self], axis=-1
-        )
-        probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
-        p_pool = probs[..., : s_pool.shape[-1]]
-        p_self = probs[..., s_pool.shape[-1]:]
-        o_pool = jnp.sum(
-            p_pool[:, :, :, :, None, :]
-            * cv[:, :, None, None].astype(jnp.float32),
-            axis=-1,
-        )  # [S, Hkv, G, T, C]
-        o_self = jnp.sum(
-            p_self[..., None] * vc[:, :, None, None].astype(jnp.float32),
-            axis=-2,
-        )  # [S, Hkv, G, T, C]
-        out = (o_pool + o_self).astype(x.dtype)
-        out = out.reshape(b, h, t, c)
+        kc = k.astype(row_dt)
+        vc = v.astype(row_dt)
+        if paged_kernel == "pallas":
+            # the ragged in-kernel block-table walk (ops.paged_attn):
+            # bitwise this method's arithmetic, none of its HBM gather
+            qg = shard_act(qg, None, "kv_heads", None, None, None)
+            out = _paged_kernel_dispatch(
+                "verify", layer,
+                (qg, kc, vc, pool_k, pool_v, bt, start),
+                (_gathered_pool_scales(pool_sk, bt, layer),
+                 _gathered_pool_scales(pool_sv, bt, layer)),
+            )  # [S, Hkv, G, T, C]
+            out = shard_act(out, None, "kv_heads", None, None, None)
+            out = out.reshape(b, h, t, c)
+        else:
+            # gather the slots' pages (clip-mode for the same NaN reason
+            # as decode_paged_at) -> logical KV [S, Hkv, C, W] in page
+            # order; int8 pools dequantize at the view
+            ck = _gathered_pool_view(pool_k, pool_sk, bt, layer)
+            cv = _gathered_pool_view(pool_v, pool_sv, bt, layer)
+            ck = shard_act(ck, None, "kv_heads", None, None)
+            cv = shard_act(cv, None, "kv_heads", None, None)
+            # scores as f32 broadcast-multiply + reduce, exactly the
+            # decode VPU form — q upcast first, cache upcast first, sum
+            # over C
+            s_pool = jnp.sum(
+                qg[..., :, None].astype(jnp.float32)
+                * ck[:, :, None, None].astype(jnp.float32),
+                axis=-2,
+            )  # [S, Hkv, G, T, W]
+            s_self = jnp.sum(
+                qg[:, :, :, :, None, :].astype(jnp.float32)
+                * kc[:, :, None, None].astype(jnp.float32),
+                axis=-1,
+            )  # [S, Hkv, G, T, T]
+            s_all = jnp.concatenate(
+                [s_pool + mask_pool, s_self + mask_self], axis=-1
+            )
+            probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
+            p_pool = probs[..., : s_pool.shape[-1]]
+            p_self = probs[..., s_pool.shape[-1]:]
+            o_pool = jnp.sum(
+                p_pool[:, :, :, :, None, :]
+                * cv[:, :, None, None].astype(jnp.float32),
+                axis=-1,
+            )  # [S, Hkv, G, T, C]
+            o_self = jnp.sum(
+                p_self[..., None] * vc[:, :, None, None].astype(jnp.float32),
+                axis=-2,
+            )  # [S, Hkv, G, T, C]
+            out = (o_pool + o_self).astype(x.dtype)
+            out = out.reshape(b, h, t, c)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * c)
         # head-contiguous merged dim feeds the row-parallel wo (one psum)
         out = shard_act(out, None, None, "heads")
@@ -1144,11 +1367,13 @@ class Block:
 
     def decode_paged_at(
         self, x, pool_k, pool_v, bt, rk, rv, layer, r, mask_pool, mask_rec,
-        sin_rows, cos_rows,
+        sin_rows, cos_rows, pooled_len=None, pool_sk=None, pool_sv=None,
+        paged_kernel="xla",
     ):
         attn_out, rk, rv = self.attn.decode_paged_at(
             self.ln1(x), pool_k, pool_v, bt, rk, rv, layer, r,
-            mask_pool, mask_rec, sin_rows, cos_rows,
+            mask_pool, mask_rec, sin_rows, cos_rows, pooled_len=pooled_len,
+            pool_sk=pool_sk, pool_sv=pool_sv, paged_kernel=paged_kernel,
         )
         x = x + attn_out
         x = x + mlp_call(self.mlp, self.ln2(x))[0]
@@ -1156,11 +1381,12 @@ class Block:
 
     def prefill_paged_at(
         self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
-        sin_rows, cos_rows,
+        sin_rows, cos_rows, start=None, pool_sk=None, pool_sv=None,
     ):
         attn_out, k, v = self.attn.prefill_paged_at(
             self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
-            sin_rows, cos_rows,
+            sin_rows, cos_rows, start=start, pool_sk=pool_sk,
+            pool_sv=pool_sv,
         )
         x = x + attn_out
         x = x + mlp_call(self.mlp, self.ln2(x))[0]
@@ -1168,11 +1394,13 @@ class Block:
 
     def verify_paged_at(
         self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
-        sin_rows, cos_rows,
+        sin_rows, cos_rows, start=None, pool_sk=None, pool_sv=None,
+        paged_kernel="xla",
     ):
         attn_out, k, v = self.attn.verify_paged_at(
             self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
-            sin_rows, cos_rows,
+            sin_rows, cos_rows, start=start, pool_sk=pool_sk,
+            pool_sv=pool_sv, paged_kernel=paged_kernel,
         )
         x = x + attn_out
         x = x + mlp_call(self.mlp, self.ln2(x))[0]
@@ -1543,6 +1771,9 @@ def decode_step_paged(
     r: Array,  # [] int32 — step index within the decode window
     pooled_len: Array,  # [S] int32 — tokens already flushed to the pool
     rope_len: int,
+    pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+    pool_sv: tp.Optional[Array] = None,
+    paged_kernel: str = "xla",
 ) -> tp.Tuple[Array, Array, Array]:
     """One decode step of the continuous-batching engine: every slot
     attends over its OWN block-table pages (positions < pooled_len[s])
@@ -1562,6 +1793,9 @@ def decode_step_paged(
     # whole per shard, so every block-table gather below is shard-local
     pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
     pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
+    if pool_sk is not None:
+        pool_sk = shard_act(pool_sk, None, None, "kv_heads")
+        pool_sv = shard_act(pool_sv, None, None, "kv_heads")
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1587,7 +1821,8 @@ def decode_step_paged(
         block = jax.tree.map(lambda a: a[i], model.blocks)
         h, rk, rv = block.decode_paged_at(
             h, pool_k, pool_v, bt, rk, rv, i, r, mask_pool, mask_rec,
-            sin_h, cos_h,
+            sin_h, cos_h, pooled_len=pooled_len, pool_sk=pool_sk,
+            pool_sv=pool_sv, paged_kernel=paged_kernel,
         )
     h = model.ln_f(h)
     # vocab-sharded logits (TP lm head is column-parallel): nothing here
@@ -1604,6 +1839,8 @@ def prefill_chunk_paged(
     pool_v: Array,
     bt: Array,  # [1, Pmax] int32 — the slot's block table
     rope_len: int,
+    pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+    pool_sv: tp.Optional[Array] = None,
 ) -> tp.Tuple[Array, Array, Array]:
     """Suffix-only prefill of one chunk against a pre-populated block
     table: the chunk's tokens (context positions ``start .. start+T-1``)
@@ -1631,6 +1868,9 @@ def prefill_chunk_paged(
     ps = pool_k.shape[-1]
     pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
     pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
+    if pool_sk is not None:
+        pool_sk = shard_act(pool_sk, None, None, "kv_heads")
+        pool_sv = shard_act(pool_sv, None, None, "kv_heads")
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1653,7 +1893,8 @@ def prefill_chunk_paged(
     for i in range(cfg.n_layer):
         block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
         h, k, v = block.prefill_paged_at(
-            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h
+            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h,
+            start=start, pool_sk=pool_sk, pool_sv=pool_sv,
         )
         ks.append(k)
         vs.append(v)
@@ -1672,6 +1913,9 @@ def verify_tokens_paged(
     pool_v: Array,
     bt: Array,  # [S, Pmax] int32 per-slot block tables
     rope_len: int,
+    pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
+    pool_sv: tp.Optional[Array] = None,
+    paged_kernel: str = "xla",
 ) -> tp.Tuple[Array, Array, Array]:
     """Speculative-decoding VERIFICATION forward: score every slot's
     ``[T = spec_len + 1]`` candidate rows (the true next token + the
@@ -1704,6 +1948,9 @@ def verify_tokens_paged(
     ps = pool_k.shape[-1]
     pool_k = shard_act(pool_k, None, None, "kv_heads", None, None)
     pool_v = shard_act(pool_v, None, None, "kv_heads", None, None)
+    if pool_sk is not None:
+        pool_sk = shard_act(pool_sk, None, None, "kv_heads")
+        pool_sv = shard_act(pool_sv, None, None, "kv_heads")
     sin_np, cos_np = rope_tables(cfg.head_dim, rope_len, cfg.rope_base)
     sin_t, cos_t = jnp.asarray(sin_np), jnp.asarray(cos_np)
 
@@ -1728,7 +1975,9 @@ def verify_tokens_paged(
     for i in range(cfg.n_layer):
         block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
         h, k, v = block.verify_paged_at(
-            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h
+            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h,
+            start=start, pool_sk=pool_sk, pool_sv=pool_sv,
+            paged_kernel=paged_kernel,
         )
         ks.append(k)
         vs.append(v)

@@ -1,0 +1,449 @@
+"""Pallas TPU ragged paged-attention kernels for serving decode/verify.
+
+The XLA paged-attention path (models.gpt.decode_paged_at /
+verify_paged_at) reads the KV pool through a block-table gather:
+``jnp.take(pool[layer], bt)`` materializes a ``[S, Pmax, Hkv, C, PS]``
+intermediate in HBM — the pool bytes are read once, written once into
+the gathered copy, and read again by the attention contraction, i.e.
+the HBM-bound decode step pays the K+V stream ~3x. These kernels walk
+each slot's block table IN-KERNEL over its ragged ``pooled_len`` (the
+"Ragged Paged Attention" formulation, PAPERS.md — the TPU kernel
+purpose-built for exactly this paged layout): every resident page is
+DMA'd from HBM into a VMEM assembly scratch exactly once, nothing
+page-shaped ever lands back in HBM, and the whole joint softmax +
+weighted-value contraction runs out of VMEM. Per decode step the pool
+traffic drops to the roofline minimum — each live K and V byte crosses
+HBM once.
+
+EXACTNESS CONTRACT (the reason this kernel looks the way it does): the
+serving suite's landing gate is greedy token-identity against the XLA
+path, and the repo has twice shipped attention variants that drifted by
+~2 bf16 ulps and flipped near-tied greedy argmaxes on real checkpoints
+(PR 4/PR 5, see analysis.choreo). A classic flash-style online-softmax
+accumulator — running max with ``exp(m_old - m_new)`` rescales folded
+into the accumulator — can NEVER be bitwise against the XLA joint
+softmax: the rescale multiplies are extra roundings. So the walk here
+is "online" in the streaming sense but defers normalization: pages
+stream once into the VMEM assembly, the running mask/length bookkeeping
+rides the walk, and the softmax itself is ONE flat f32 pass over the
+VMEM-resident scores — the exact op sequence (same primitives, same
+reduce extents, mask added before the in-softmax ``/ sqrt(C)`` scale,
+f32 probs through the PV sums) as ``decode_paged_at``. The result is
+BITWISE equal to the XLA gather path (asserted by
+tests/test_paged_attn.py down to the f32 pattern), so the kernel slots
+under the existing token-identity matrix instead of weakening it to a
+tolerance. The VMEM cost is the assembly scratch, O(context) instead of
+O(1) — at serving block sizes (<= 8K tokens) that is a few MB against
+the 16 MB budget; a context long enough to break that is ring/offload
+territory, not a paged decode batch.
+
+INT8 KV (``scale_k``/``scale_v`` given): the pool payload is int8 with
+one f32 power-of-two scale per (page, KV-head) plane
+(serving.paged — the KV analogue of quant.py's po2 exactness contract).
+Dequantization happens in-kernel at the VMEM boundary:
+``f32(q) * scale`` with ``|q| <= 127`` and a po2 scale is EXACT, so the
+kernel is bitwise against dequantize-then-attend — an int8 pool behaves
+like a bf16 pool whose values happen to lie on the page grid, and the
+greedy token streams stay invariant across every engine feature
+combination (unit-tested at the page level).
+
+Dtype choreography (machine-checked: analysis.choreo extracts the
+kernel body's softmax signature and proves it equal to the decode
+window's — a bf16-accumulating edit here turns the serving-choreo CI
+gate red): bf16 Q/K products formed as f32 upcast-multiplies, f32 score
+accumulation, additive mask before the in-softmax scale, one joint f32
+exp per layer, f32 probs through the PV sums, output rounded to the
+compute dtype once at the end.
+
+CPU/tier-1: the kernels run under the Pallas interpreter (no TPU
+required) — ``interpret`` defaults to "not on a TPU backend", so the
+tier-1 suite and the CI serving gates execute the very same kernel
+bodies the hardware runs. The XLA gather path stays available as a
+config-selected fallback (``ServingEngine(paged_kernel="xla")``),
+exactly as ops/flash.py keeps naive attention for training.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+# The score-accumulation dtype of both kernels. Module-level so the
+# choreography fault-injection test (tests/test_choreo.py) can
+# monkeypatch a bf16-accumulating kernel variant and prove the prover
+# catches it; the shipped value is load-bearing — f32 accumulation IS
+# the decode choreography contract.
+SCORE_ACC_DTYPE = jnp.float32
+
+
+def _interpret_default() -> bool:
+    from midgpt_tpu.utils.platform import is_tpu_backend
+
+    return not is_tpu_backend()
+
+
+def supported(pmax: int, page_size: int, hkv: int, c: int,
+              itemsize: int, groups: int = 8, spec_t: int = 1) -> bool:
+    """Does the assembly scratch for this geometry fit comfortably in
+    VMEM? K + V assembly at pool dtype plus f32 score/prob headroom
+    (``groups`` = query heads per KV head — the [Hkv, G, W] score and
+    prob buffers scale with it; ``spec_t`` = candidate rows per slot in
+    the verify kernel, whose score/prob buffers are [Hkv, G, T, W] —
+    pass ``speculate + 1`` when speculation is on), against a
+    conservative 12 MB budget (of ~16 MB/core). A sub-f32 pool
+    (bf16, and worst int8 — 1 counted byte vs 4 materialized) also pays
+    for the f32 dequant/upcast copies of BOTH assemblies that
+    ``_dequant_view`` builds on top of the pool-dtype scratch; omitting
+    them let ``auto`` pick the kernel on geometries whose real VMEM
+    demand overflowed Mosaic (code-review finding)."""
+    w = pmax * page_size
+    assembly = 2 * hkv * c * w * itemsize
+    if itemsize < 4:
+        # f32 ck/cv views of the K and V assemblies
+        assembly += 2 * hkv * c * w * 4
+    # [Hkv, G, T, W] f32, x4 headroom (scores + probs + exp temps)
+    scores = 4 * hkv * max(1, groups) * max(1, spec_t) * w * 4
+    return assembly + scores <= 12 * 1024 * 1024
+
+
+def _dequant_view(buf: Array, scales_ref, hkv: int, pmax: int,
+                  ps: int) -> Array:
+    """VMEM assembly [Hkv, C, W] -> f32 stream values. For an int8 pool
+    the per-page scale plane broadcasts to per-position columns and the
+    dequant multiply is exact (|q| <= 127, po2 scale — quant.py's
+    epilogue contract, applied to the KV stream)."""
+    w = pmax * ps
+    if scales_ref is None:
+        return buf.astype(jnp.float32)
+    sc = scales_ref[0]  # [Pmax, Hkv] f32
+    scw = jnp.transpose(sc, (1, 0))[:, :, None]  # [Hkv, Pmax, 1]
+    scw = jnp.broadcast_to(scw, (hkv, pmax, ps)).reshape(hkv, 1, w)
+    return buf.astype(jnp.float32) * scw
+
+
+def _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
+                    sem, ps: int):
+    """The in-kernel block-table walk: zero the assembly scratch, then
+    DMA each resident page of slot ``s`` (K and V, this layer) from HBM
+    into its [.., i*PS:(i+1)*PS] assembly columns — each page crosses
+    HBM exactly once. Page ids are clipped like the XLA path's
+    ``mode="clip"`` gather (pads beyond ``npages`` are never walked;
+    the clip is defense against a corrupt table, and clipped garbage is
+    erased by the -inf mask before the softmax). The zero-fill is what
+    makes un-walked columns safe: masked scores become exactly
+    ``0 + (-inf)`` and masked value columns contribute exactly
+    ``0.0 * 0.0`` — finite, so no NaN can leak through ``0 * garbage``."""
+    np_total = pk_ref.shape[1]
+    kbuf[...] = jnp.zeros_like(kbuf)
+    vbuf[...] = jnp.zeros_like(vbuf)
+
+    def body(i, carry):
+        page = jnp.clip(bt_ref[s, i], 0, np_total - 1)
+        cpk = pltpu.make_async_copy(
+            pk_ref.at[layer, page], kbuf.at[:, :, pl.ds(i * ps, ps)],
+            sem.at[0],
+        )
+        cpk.start()
+        cpv = pltpu.make_async_copy(
+            pv_ref.at[layer, page], vbuf.at[:, :, pl.ds(i * ps, ps)],
+            sem.at[1],
+        )
+        cpv.start()
+        cpk.wait()
+        cpv.wait()
+        return carry
+
+    jax.lax.fori_loop(0, npages, body, 0)
+
+
+def _decode_kernel(
+    # scalar prefetch
+    bt_ref,      # [S, Pmax] int32
+    len_ref,     # [S] int32 — pooled_len
+    r_ref,       # [1] int32 — step index within the window
+    # inputs
+    q_ref,       # [1, Hkv, G, C] block — this slot's post-rope queries
+    rk_ref,      # [1, Hkv, R, C] block — recent K rows (this layer)
+    rv_ref,      # [1, Hkv, R, C] block
+    sk_ref,      # [1, Pmax, Hkv] f32 block or None (int8 pool only)
+    sv_ref,
+    pk_ref,      # [L, NP, Hkv, C, PS] pool K, HBM/ANY
+    pv_ref,
+    # outputs / scratch
+    out_ref,     # [1, Hkv, G, C] block
+    kbuf,        # VMEM [Hkv, C, Pmax*PS] pool dtype
+    vbuf,
+    sem,
+    *,
+    layer: int,
+    ps: int,
+):
+    s = pl.program_id(0)
+    hkv, c, w = kbuf.shape
+    pmax = w // ps
+    rr = rk_ref.shape[2]
+    npages = pl.cdiv(len_ref[s], ps)
+    _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
+                    sem, ps)
+    ck = _dequant_view(kbuf[...], sk_ref, hkv, pmax, ps)  # [Hkv, C, W] f32
+    cv = _dequant_view(vbuf[...], sv_ref, hkv, pmax, ps)
+    qs = q_ref[0]  # [Hkv, G, C]
+    # masks: identical values to the XLA path's (0 / -inf f32)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)[0]
+    mask_pool = jnp.where(idx < len_ref[s], 0.0, -jnp.inf).astype(
+        jnp.float32
+    )
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (1, rr), 1)[0]
+    mask_rec = jnp.where(ridx <= r_ref[0], 0.0, -jnp.inf).astype(
+        jnp.float32
+    )
+    # the decode choreography, op for op (decode_paged_at): f32
+    # upcast-multiplies, f32 accumulation, mask BEFORE the in-softmax
+    # scale, one joint exp, f32 probs through the PV sums
+    qcw = qs[:, :, :, None]  # [Hkv, G, C, 1]
+    s_pool = jnp.sum(
+        qcw.astype(SCORE_ACC_DTYPE) * ck[:, None].astype(SCORE_ACC_DTYPE),
+        axis=-2, dtype=SCORE_ACC_DTYPE,
+    )  # [Hkv, G, W]
+    rkl = rk_ref[0]  # [Hkv, R, C]
+    rvl = rv_ref[0]
+    s_rec = jnp.sum(
+        qs[:, :, None, :].astype(SCORE_ACC_DTYPE)
+        * rkl[:, None].astype(SCORE_ACC_DTYPE),
+        axis=-1, dtype=SCORE_ACC_DTYPE,
+    )  # [Hkv, G, R]
+    s_all = jnp.concatenate([s_pool + mask_pool, s_rec + mask_rec], axis=-1)
+    probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
+    p_pool = probs[..., :w]
+    p_rec = probs[..., w:]
+    o_pool = jnp.sum(
+        p_pool[:, :, None, :] * cv[:, None].astype(jnp.float32), axis=-1
+    )  # [Hkv, G, C]
+    o_rec = jnp.sum(
+        p_rec[..., None] * rvl[:, None].astype(jnp.float32), axis=-2
+    )
+    out_ref[0] = (o_pool + o_rec).astype(out_ref.dtype)
+
+
+def paged_decode_attention(
+    q: Array,        # [S, Hkv, G, C] post-rope/norm queries, compute dtype
+    pool_k: Array,   # [L, NP, Hkv, C, PS] pool (bf16/f32, or int8)
+    pool_v: Array,
+    bt: Array,       # [S, Pmax] int32 block tables
+    pooled_len: Array,  # [S] int32 — ragged per-slot resident lengths
+    rk_l: Array,     # [S, Hkv, R, C] recent K rows, THIS layer
+    rv_l: Array,
+    r: Array,        # [] int32 — step index within the window
+    layer: int,      # STATIC layer index
+    scale_k: tp.Optional[Array] = None,  # [S, Pmax, Hkv] f32 gathered
+    scale_v: tp.Optional[Array] = None,  # per-page scales (int8 pool)
+    interpret: tp.Optional[bool] = None,
+) -> Array:  # [S, Hkv, G, C] compute dtype
+    """One decode step's paged attention for all slots: pool part read
+    by an in-kernel ragged block-table walk, recent part from the
+    window's write buffer, one joint softmax — bitwise the XLA gather
+    path's result without the gathered HBM intermediate."""
+    s, hkv, g, c = q.shape
+    l, np_total, _, _, ps = pool_k.shape
+    pmax = bt.shape[1]
+    quant = scale_k is not None
+    if interpret is None:
+        interpret = _interpret_default()
+    kern = functools.partial(_decode_kernel, layer=layer, ps=ps)
+    if not quant:
+        kern = _drop_scale_refs(kern, n_scalar=3)
+    in_specs = [
+        pl.BlockSpec((1, hkv, g, c), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec(
+            (1, hkv, rk_l.shape[2], c), lambda i, *_: (i, 0, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, hkv, rk_l.shape[2], c), lambda i, *_: (i, 0, 0, 0)
+        ),
+    ]
+    args = [q, rk_l, rv_l]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+        ]
+        args += [scale_k, scale_v]
+    in_specs += [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    args += [pool_k, pool_v]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, hkv, g, c), lambda i, *_: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, c, pmax * ps), pool_k.dtype),
+            pltpu.VMEM((hkv, c, pmax * ps), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, c), q.dtype),
+        interpret=interpret,
+    )(bt, pooled_len, jnp.reshape(r, (1,)), *args)
+
+
+def _drop_scale_refs(kern, n_scalar: int):
+    """Adapt a kernel written for the quantized operand list (scale
+    blocks present) to the float-pool call (scales absent): insert None
+    where the scale refs would sit. Positions: scalars, then 3 tensor
+    blocks (q + two row buffers), then [sk, sv], then pool refs."""
+
+    @functools.wraps(kern)
+    def wrapped(*refs):
+        pre = refs[: n_scalar + 3]
+        post = refs[n_scalar + 3:]
+        return kern(*pre, None, None, *post)
+
+    return wrapped
+
+
+def _verify_kernel(
+    # scalar prefetch
+    bt_ref,      # [S, Pmax] int32
+    start_ref,   # [S] int32 — per-slot write watermark
+    # inputs
+    q_ref,       # [1, Hkv, G, T, C] block
+    kc_ref,      # [1, Hkv, T, C] block — cache-rounded self K rows
+    vc_ref,      # [1, Hkv, T, C] block
+    sk_ref,      # [1, Pmax, Hkv] f32 block or None
+    sv_ref,
+    pk_ref,      # [L, NP, Hkv, C, PS] pool, HBM/ANY
+    pv_ref,
+    out_ref,     # [1, Hkv, G, T, C] block
+    kbuf,
+    vbuf,
+    sem,
+    *,
+    layer: int,
+    ps: int,
+):
+    s = pl.program_id(0)
+    hkv, c, w = kbuf.shape
+    pmax = w // ps
+    t = kc_ref.shape[2]
+    npages = pl.cdiv(start_ref[s], ps)
+    _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
+                    sem, ps)
+    ck = _dequant_view(kbuf[...], sk_ref, hkv, pmax, ps)  # [Hkv, C, W]
+    cv = _dequant_view(vbuf[...], sv_ref, hkv, pmax, ps)
+    qs = q_ref[0]  # [Hkv, G, T, C]
+    kc = kc_ref[0]  # [Hkv, T, C]
+    vc = vc_ref[0]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)[0]
+    mask_pool = jnp.where(idx < start_ref[s], 0.0, -jnp.inf).astype(
+        jnp.float32
+    )
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    mask_self = jnp.where(cols <= rows, 0.0, -jnp.inf).astype(jnp.float32)
+    # the decode choreography over T candidate rows (verify_paged_at op
+    # for op): f32 upcast-multiplies, f32 accumulation, one joint exp,
+    # f32 probs through the PV sums
+    s_pool = jnp.sum(
+        qs[..., :, None].astype(SCORE_ACC_DTYPE)
+        * ck[:, None, None].astype(SCORE_ACC_DTYPE),
+        axis=-2, dtype=SCORE_ACC_DTYPE,
+    )  # [Hkv, G, T, W]
+    s_self = jnp.sum(
+        qs[:, :, :, None, :].astype(SCORE_ACC_DTYPE)
+        * kc[:, None, None].astype(SCORE_ACC_DTYPE),
+        axis=-1, dtype=SCORE_ACC_DTYPE,
+    )  # [Hkv, G, T, T]
+    s_all = jnp.concatenate(
+        [s_pool + mask_pool, s_self + mask_self], axis=-1
+    )
+    probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
+    p_pool = probs[..., :w]
+    p_self = probs[..., w:]
+    o_pool = jnp.sum(
+        p_pool[:, :, :, None, :] * cv[:, None, None].astype(jnp.float32),
+        axis=-1,
+    )  # [Hkv, G, T, C]
+    o_self = jnp.sum(
+        p_self[..., None] * vc[:, None, None].astype(jnp.float32),
+        axis=-2,
+    )
+    out_ref[0] = (o_pool + o_self).astype(out_ref.dtype)
+
+
+def paged_verify_attention(
+    q: Array,        # [S, Hkv, G, T, C] compute dtype
+    kc: Array,       # [S, Hkv, T, C] cache-rounded self K rows
+    vc: Array,
+    pool_k: Array,   # [L, NP, Hkv, C, PS]
+    pool_v: Array,
+    bt: Array,       # [S, Pmax] int32
+    start: Array,    # [S] int32 — write watermark (resident tokens)
+    layer: int,
+    scale_k: tp.Optional[Array] = None,  # [S, Pmax, Hkv] f32 gathered
+    scale_v: tp.Optional[Array] = None,
+    interpret: tp.Optional[bool] = None,
+) -> Array:  # [S, Hkv, G, T, C]
+    """Speculative-verify paged attention: all T candidate rows of every
+    slot against its ragged resident pages plus themselves (causal), one
+    joint softmax, decode choreography — the kernel twin of
+    ``Attention.verify_paged_at`` with the same in-kernel walk as
+    :func:`paged_decode_attention`."""
+    s, hkv, g, t, c = q.shape
+    l, np_total, _, _, ps = pool_k.shape
+    pmax = bt.shape[1]
+    quant = scale_k is not None
+    if interpret is None:
+        interpret = _interpret_default()
+    kern = functools.partial(_verify_kernel, layer=layer, ps=ps)
+    if not quant:
+        kern = _drop_scale_refs(kern, n_scalar=2)
+    in_specs = [
+        pl.BlockSpec((1, hkv, g, t, c), lambda i, *_: (i, 0, 0, 0, 0)),
+        pl.BlockSpec((1, hkv, t, c), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec((1, hkv, t, c), lambda i, *_: (i, 0, 0, 0)),
+    ]
+    args = [q, kc, vc]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+        ]
+        args += [scale_k, scale_v]
+    in_specs += [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    args += [pool_k, pool_v]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, hkv, g, t, c), lambda i, *_: (i, 0, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, c, pmax * ps), pool_k.dtype),
+            pltpu.VMEM((hkv, c, pmax * ps), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hkv, g, t, c), q.dtype),
+        interpret=interpret,
+    )(bt, start, *args)

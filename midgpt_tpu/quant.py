@@ -103,7 +103,7 @@ class QuantLinear:
 
 def _po2_ceil(x: Array) -> Array:
     """Smallest power of two >= x (elementwise, x > 0)."""
-    return jnp.exp2(jnp.ceil(jnp.log2(x)))
+    return po2_ceil_exact(jnp.asarray(x, jnp.float32))
 
 
 def quantize_per_channel(
@@ -149,6 +149,142 @@ def dequantize(q: Array, scale: Array) -> Array:
     rounding (up to ~31 significant bits into 24), so no bitwise
     contract holds there."""
     return q.astype(jnp.float32) * scale[..., None, :]
+
+
+# ---------------------------------------------------------------------------
+# Int8 KV-cache grid (the serving paged pool, midgpt_tpu.serving.paged)
+#
+# The KV analogue of the po2 weight contract above, with one extra
+# obligation the weights never had: pool pages are quantized INCREMENTALLY
+# (a page's rows arrive across decode windows / prefill chunks / verify
+# dispatches), so the scale of a page must be a pure function of the token
+# stream — never of window size, chunk size, or speculation — or the
+# engine's greedy token-identity matrix breaks. The scheme: one f32 po2
+# scale per (page, KV-head) plane, fixed at PAGE BIRTH from the page's
+# first row (positions fill contiguously, so every writer sees the same
+# birth row), and every in-dispatch reader sees rows ROUNDED through that
+# grid — a value on the grid survives quantize -> dequantize bitwise
+# (|q| <= 127 times a po2 scale is exact in f32 AND bf16), so the int8
+# pool behaves exactly like a bf16 pool whose values happen to lie on the
+# grid. Scale derivation is ROUNDING-STABLE (tested): deriving from a
+# row already rounded to its own grid returns the identical scale, which
+# is what lets the bulk page writes re-derive scales from the rounded
+# rows they receive instead of threading scale state through every scan.
+# ---------------------------------------------------------------------------
+
+KV_QMAX = 127.0
+# the BIRTH-ROW divisor: a page's scale targets its first row's absmax
+# at code <= 63, leaving one power-of-two of headroom for the LATER
+# rows that share the scale (codes clip at +-127, so a later row only
+# clips past ~2-4x the birth absmax — rare for stationary activations;
+# with divisor 127 any later row larger than the birth row clipped).
+# 63 is also what keeps scale derivation ROUNDING-STABLE: a rounded
+# birth row's absmax is q * s with q = round(absmax/s) in [32, 63], and
+# q*s/63 lands in (0.5079*s, s] — strictly inside the po2-ceil bucket
+# of s, so re-deriving from the rounded row returns s bit-for-bit.
+# (Divisor 127 with headroom *2 would put the boundary at 64/127 =
+# 0.5039 of TWICE the scale — the wrong side of a po2 boundary.)
+KV_BIRTH_QMAX = 63.0
+# Scale floor: the smallest NORMAL f32 power of two. A subnormal scale
+# would be correct arithmetic on paper, but XLA CPU flushes subnormal
+# operands/results to zero (FTZ), so ``q * scale`` and ``row / scale``
+# stop being exact — and whether a backend flushes is implementation
+# noise. Clamping here keeps every grid product (|q| <= 127 times a
+# normal po2) normal in f32 AND bf16 on every backend; rows tiny enough
+# to want a smaller scale (absmax < ~63 * 2^-126) round to codes near
+# zero, which is the right answer for values of that size anyway.
+KV_SCALE_MIN = 2.0**-126
+
+
+def _pow2_f32(e: Array) -> Array:
+    """Exact f32 ``2**e`` from an integer exponent, assembled from IEEE
+    bit fields — NOT ``jnp.exp2``, whose polynomial approximation is off
+    by ulps at integer arguments outside a narrow band (measured on XLA
+    CPU: wrong at e = -13, 13, 15, ... and everything past ~[-14, 28],
+    underflowing to 0.0 below ~-125). Normal range [-126, 127] sets the
+    exponent field; [-149, -127] sets the matching subnormal mantissa
+    bit; past either end the true f32 value of ``2**e`` is inf / 0.0."""
+    e = jnp.asarray(e, jnp.int32)
+    en = jnp.clip(e, -126, 128)  # 128 -> biased 255 -> inf
+    normal = ((en + 127) << 23).astype(jnp.uint32)
+    sub = jnp.left_shift(
+        jnp.uint32(1), jnp.clip(e + 149, 0, 23).astype(jnp.uint32)
+    )
+    bits = jnp.where(
+        e >= -126, normal, jnp.where(e >= -149, sub, jnp.uint32(0))
+    )
+    return jax.lax.bitcast_convert_type(bits, jnp.float32)
+
+
+def po2_ceil_exact(y: Array) -> Array:
+    """Smallest power of two >= y (y > 0), computed by EXPONENT
+    EXTRACTION (frexp) + bit assembly rather than
+    ``exp2(ceil(log2 y))`` — log2 AND exp2 are approximations (exp2's
+    value at plain integer arguments is implementation noise, see
+    :func:`_pow2_f32`), and the KV grid's rounding-stability proof needs
+    the boundary case ``y == 2^k`` to land on ``2^k`` bit-for-bit on
+    every backend. The decomposition reads the IEEE bit fields directly
+    (bitcast) instead of calling frexp on y: jax's frexp misreads the
+    zero exponent field of subnormals (returns e=-149 for all of them
+    on this pin), and XLA CPU flushes subnormal arithmetic to zero, so
+    no float-arithmetic normalization of a subnormal is trustworthy.
+    Writing y = mant * 2^k with integer mant in [1, 2^24) (normals get
+    the implicit leading bit ORed in, subnormals are already that form),
+    mant converts to f32 EXACTLY and lands in frexp's well-behaved
+    normal range."""
+    y = jnp.asarray(y, jnp.float32)
+    bits = jax.lax.bitcast_convert_type(y, jnp.uint32)  # y > 0: sign 0
+    expf = (bits >> 23).astype(jnp.int32)
+    mant = (bits & jnp.uint32(0x7FFFFF)).astype(jnp.int32)
+    mant_full = jnp.where(expf > 0, mant | (1 << 23), mant)
+    k = jnp.where(expf > 0, expf - 150, -149)  # y = mant_full * 2^k
+    m, e = jnp.frexp(mant_full.astype(jnp.float32))
+    e = e.astype(jnp.int32) + k
+    return jnp.where(m == 0.5, _pow2_f32(e - 1), _pow2_f32(e))
+
+
+def kv_scale_from_absmax(absmax: Array) -> Array:
+    """Per-(page, head) po2 KV scale from a birth row's |absmax| over
+    head_dim: smallest po2 >= absmax / 63 (the birth row's codes stay
+    <= 63, leaving one bit of headroom for the later rows that share
+    the page's scale — see KV_BIRTH_QMAX), floored at KV_SCALE_MIN (the
+    smallest normal po2 — subnormal scales are FTZ territory), 1.0 for
+    an EFFECTIVELY all-zero row: absmax <= KV_SCALE_MIN/2 rounds to
+    code 0 even on the floored grid (banker's round of <= 0.5), so the
+    rounded row is all zeros and re-deriving from it must return the
+    same scale — those rows take the all-zero branch up front. f32 in,
+    f32 out."""
+    am = jnp.asarray(absmax, jnp.float32)
+    sc = jnp.maximum(
+        po2_ceil_exact(am / KV_BIRTH_QMAX), jnp.float32(KV_SCALE_MIN)
+    )
+    return jnp.where(am > jnp.float32(KV_SCALE_MIN / 2), sc, 1.0)
+
+
+def quantize_kv_rows(rows: Array, scales: Array) -> Array:
+    """``rows [..., C]`` x ``scales [...]`` -> int8 codes. Exact (no
+    rounding at all) when the rows are already on the grid — the case
+    the serving write paths are in, because every row was rounded
+    in-dispatch before anyone read it."""
+    q = jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scales[..., None]),
+        -KV_QMAX, KV_QMAX,
+    )
+    return q.astype(jnp.int8)
+
+
+def round_kv_rows_to_grid(rows: Array, scales: Array) -> Array:
+    """Round K/V rows through their page's int8 grid, returned in the
+    rows' own dtype: ``round(row / s) * s`` with ``|code| <= 127`` and a
+    po2 ``s`` is exactly representable in bf16 and f32, so the returned
+    values are BITWISE what a later pool read will dequantize to — the
+    statement that makes in-dispatch reads and post-flush reads of the
+    same position indistinguishable."""
+    q = jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scales[..., None]),
+        -KV_QMAX, KV_QMAX,
+    )
+    return (q * scales[..., None]).astype(rows.dtype)
 
 
 def quantize_linear(lin: Linear, *, mode: str = "po2") -> QuantLinear:

@@ -101,6 +101,8 @@ def generate_served(
     prefill_budget: tp.Optional[int] = None,
     speculate: int = 0,
     quant: tp.Optional[str] = None,
+    kv_quant: tp.Optional[str] = None,
+    paged_kernel: str = "auto",
     mesh=None,
 ) -> tp.List[np.ndarray]:
     """One-shot batch generation routed through the serving engine: submit
@@ -130,6 +132,8 @@ def generate_served(
         prefill_budget=prefill_budget,
         speculate=speculate,
         quant=quant,
+        kv_quant=kv_quant,
+        paged_kernel=paged_kernel,
         mesh=mesh,
     )
     rids = [

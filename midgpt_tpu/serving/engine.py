@@ -165,17 +165,21 @@ def make_decode_window(
     temperature: float = 0.0,
     top_k: tp.Optional[int] = None,
     mesh=None,
+    paged_kernel: str = "xla",
 ):
+    # paged_kernel sits BEFORE the mesh fingerprint: the fingerprint
+    # stays the key's last element (the cache-distinctness test and any
+    # cache introspection key off that position)
     key = (
         "decode_window", model.config, slots, window, pmax, rope_len,
-        pad_id, temperature, top_k, _mesh_key(mesh),
+        pad_id, temperature, top_k, paged_kernel, _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_decode_window(
             model.config, slots=slots, window=window, pmax=pmax,
             rope_len=rope_len, pad_id=pad_id, temperature=temperature,
-            top_k=top_k, mesh=mesh,
+            top_k=top_k, mesh=mesh, paged_kernel=paged_kernel,
         ),
     )
 
@@ -191,6 +195,7 @@ def _build_decode_window(
     temperature: float,
     top_k: tp.Optional[int],
     mesh,
+    paged_kernel: str = "xla",
 ):
     """The fused K-step decode program: ONE jitted, pool/logits-donating
     ``lax.scan`` over ``window`` whole-model decode steps.
@@ -236,8 +241,11 @@ def _build_decode_window(
             f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
         )
         with axis_rules(mesh, serving_logical_rules()):
-            rk = jnp.zeros(rshape, pool.k.dtype)
-            rv = jnp.zeros(rshape, pool.k.dtype)
+            # recent rows travel in the pool's ROW dtype: the pool dtype
+            # for float pools, bf16 grid-rounded values for int8 pools
+            # (PagedKVPool.row_dtype)
+            rk = jnp.zeros(rshape, pool.row_dtype)
+            rv = jnp.zeros(rshape, pool.row_dtype)
 
             def sample(lg, em):
                 if temperature == 0.0:
@@ -270,7 +278,8 @@ def _build_decode_window(
                 pos = pooled_len + r  # per-slot absolute position
                 new_logits, rk, rv = decode_step_paged(
                     model, tok, pos, pool.k, pool.v, bt, rk, rv, r,
-                    pooled_len, rope_len,
+                    pooled_len, rope_len, pool_sk=pool.scale_k,
+                    pool_sv=pool.scale_v, paged_kernel=paged_kernel,
                 )
                 # the carry is f32 regardless of compute dtype (an exact
                 # widening — sampling sees the same values either way)
@@ -348,7 +357,7 @@ def _build_prefill_chunk_program(
         with axis_rules(mesh, serving_logical_rules()):
             h, ks, vs = prefill_chunk_paged(
                 model, tokens, start, pool.k, pool.v, bt_row[None, :],
-                rope_len,
+                rope_len, pool_sk=pool.scale_k, pool_sv=pool.scale_v,
             )  # h: [1, T, D]; ks/vs: [L, 1, Hkv, T, C]
             pool = write_token_rows(
                 pool, ks[:, 0], vs[:, 0], bt_row, start, real_n
@@ -379,16 +388,18 @@ def make_verify_program(
     rope_len: int,
     pad_id: int = 0,
     mesh=None,
+    paged_kernel: str = "xla",
 ):
     key = (
         "verify", model.config, slots, spec_len, pmax, rope_len, pad_id,
-        _mesh_key(mesh),
+        paged_kernel, _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_verify_program(
             model.config, slots=slots, spec_len=spec_len, pmax=pmax,
             rope_len=rope_len, pad_id=pad_id, mesh=mesh,
+            paged_kernel=paged_kernel,
         ),
     )
 
@@ -402,6 +413,7 @@ def _build_verify_program(
     rope_len: int,
     pad_id: int,
     mesh,
+    paged_kernel: str = "xla",
 ):
     """The speculative-decoding verification program: ONE jitted,
     pool/logits-donating dispatch that scores every slot's
@@ -458,7 +470,9 @@ def _build_verify_program(
             t0 = jnp.where(done, jnp.int32(pad_id), t0)
             cand = jnp.concatenate([t0[:, None], drafts], axis=1)  # [S, T]
             all_logits, ks, vs = verify_tokens_paged(
-                model, cand, pooled_len, pool.k, pool.v, bt, rope_len
+                model, cand, pooled_len, pool.k, pool.v, bt, rope_len,
+                pool_sk=pool.scale_k, pool_sv=pool.scale_v,
+                paged_kernel=paged_kernel,
             )  # all_logits: [S, T, V]; ks/vs: [L, S, Hkv, T, C]
             preds = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
             # draft row j (cand[:, j], j >= 1) matches iff it equals the
@@ -527,6 +541,8 @@ def trace_serving_programs(
     page_size: int = 16,
     num_pages: tp.Optional[int] = None,
     mesh=None,
+    kv_quant: tp.Optional[str] = None,
+    paged_kernel: str = "xla",
 ) -> tp.Dict[str, tp.Any]:
     """Abstractly trace the engine's three hot-path programs to jaxprs —
     the input of the arithmetic-choreography prover
@@ -546,7 +562,8 @@ def trace_serving_programs(
     if num_pages is None:
         num_pages = slots * pmax
     pool = jax.eval_shape(
-        lambda: PagedKVPool.init(cfg, num_pages, page_size)
+        lambda: PagedKVPool.init(cfg, num_pages, page_size,
+                                 kv_quant=kv_quant)
     )
     f32 = jnp.float32
     sds = jax.ShapeDtypeStruct
@@ -556,7 +573,7 @@ def trace_serving_programs(
 
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
-        rope_len=cfg.block_size, mesh=mesh,
+        rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
     )
     decode_jaxpr = jax.make_jaxpr(window_fn)(
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
@@ -573,7 +590,7 @@ def trace_serving_programs(
     )
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
-        rope_len=cfg.block_size, mesh=mesh,
+        rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
     )
     verify_jaxpr = jax.make_jaxpr(verify_fn)(
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
@@ -703,10 +720,29 @@ class ServingEngine:
         speculate: int = 0,
         proposer: tp.Optional[Proposer] = None,
         quant: tp.Optional[str] = None,
+        kv_quant: tp.Optional[str] = None,
+        paged_kernel: str = "auto",
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
     ):
         assert slots >= 1 and window >= 1 and page_size >= 1
+        # int8 quantized KV pool (serving.paged / quant.py's KV grid):
+        # page payloads store int8 with one f32 po2 scale per
+        # (page, KV-head) plane, halving the K+V HBM stream every decode
+        # step pays — the largest remaining stream after the int8 weight
+        # path (PERF.md). Greedy token streams stay invariant across the
+        # whole feature matrix (cache x chunking x speculation x
+        # eviction x tp): scales are fixed at page birth and every
+        # in-dispatch reader sees grid-rounded rows.
+        assert kv_quant in (None, "int8"), f"unknown kv_quant {kv_quant!r}"
+        self.kv_quant = kv_quant
+        # paged-attention backend: "pallas" = the ragged in-kernel
+        # block-table walk (ops.paged_attn — pages stream once, no
+        # gathered HBM intermediate; interpret-mode on CPU), "xla" = the
+        # gather path, "auto" = pallas on TPU when the assembly fits
+        # VMEM, xla otherwise (same dispatch philosophy as
+        # ops/attention's flash-vs-naive)
+        assert paged_kernel in ("auto", "pallas", "xla"), paged_kernel
         # quantized weight path (midgpt_tpu.quant): quant="int8" converts
         # the model to the int8 per-channel serving pytree here, so every
         # program this engine compiles (decode window, prefill chunk,
@@ -737,6 +773,30 @@ class ServingEngine:
         # replicas — serving.cluster — not a sharded slot axis), so a
         # serving mesh is tensor-only (extra replica/fsdp axes are
         # tolerated but simply ride replicated).
+        if paged_kernel == "auto":
+            from midgpt_tpu.ops.paged_attn import supported as pk_supported
+            from midgpt_tpu.utils.platform import is_tpu_backend
+
+            itemsize = 1 if kv_quant == "int8" else jnp.dtype(
+                cache_dtype
+            ).itemsize
+            # the kernel runs per TP shard (Hkv/tp heads in its VMEM
+            # assembly), so the fit check must see the SHARD geometry —
+            # the full-pool check would fall back to the XLA gather on
+            # configs that fit fine once sharded (divisibility of
+            # kv_heads by tp is asserted below)
+            auto_tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+            paged_kernel = (
+                "pallas"
+                if is_tpu_backend() and pk_supported(
+                    pages_needed(cfg.block_size, page_size), page_size,
+                    max(1, cfg.kv_heads // auto_tp), cfg.head_dim, itemsize,
+                    groups=cfg.n_head // cfg.kv_heads,
+                    spec_t=speculate + 1,
+                )
+                else "xla"
+            )
+        self.paged_kernel = paged_kernel
         self.tp = 1
         if mesh is not None:
             from midgpt_tpu.models.gpt import (
@@ -815,7 +875,8 @@ class ServingEngine:
         # page growth provisions this many
         self._grow = (self.speculate + 1) if self.speculate else window
         self.pool = PagedKVPool.init(
-            cfg, num_pages, page_size, cache_dtype, mesh=mesh
+            cfg, num_pages, page_size, cache_dtype, mesh=mesh,
+            kv_quant=kv_quant,
         )
         self.logits = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
         if mesh is not None:
@@ -876,6 +937,7 @@ class ServingEngine:
                 rope_len=self.block,
                 pad_id=pad_id,
                 mesh=mesh,
+                paged_kernel=self.paged_kernel,
             )
             self._window_fn = None
         else:
@@ -890,6 +952,7 @@ class ServingEngine:
                 temperature=temperature,
                 top_k=top_k,
                 mesh=mesh,
+                paged_kernel=self.paged_kernel,
             )
         self._chunk_fns: tp.Dict[int, tp.Any] = {}
         self._copy_fn = make_copy_page_program()

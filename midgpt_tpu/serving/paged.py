@@ -35,6 +35,11 @@ import jax.numpy as jnp
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.parallel.sharding import shard_act
 from midgpt_tpu.pytree import module, static
+from midgpt_tpu.quant import (
+    kv_scale_from_absmax,
+    quantize_kv_rows,
+    round_kv_rows_to_grid,
+)
 
 Array = jax.Array
 
@@ -45,30 +50,59 @@ Array = jax.Array
 # head dim splits. Batch/page index arrays (block tables, pooled_len,
 # masks) are replicated.
 POOL_SPEC_AXES = (None, None, "kv_heads", None, None)
+# the per-(page, KV-head) scale planes [L, NP, Hkv] of an int8 pool
+# shard with their heads, like the payload
+SCALE_SPEC_AXES = (None, None, "kv_heads")
 
 
 @module
 class PagedKVPool:
     """The shared page pool; leaves carry a leading n_layer axis like the
-    scan-stacked block params (and KVCache)."""
+    scan-stacked block params (and KVCache).
 
-    k: Array  # [L, NP, Hkv, C, PS]
+    ``kv_quant="int8"`` (init) stores the payload int8 with one f32
+    power-of-two scale per (page, KV-head) plane (``scale_k`` /
+    ``scale_v`` — K and V quantize independently), halving the KV HBM
+    stream serving decode pays every step. Scales are fixed at PAGE
+    BIRTH from the page's first row and travel with the page through
+    copy-on-write duplication, prefix-cache aliasing and cold
+    retirement — a page's payload and its scale are one atomic unit
+    (a stale scale on an aliased page is silent corruption; see
+    :func:`copy_page`). Exactness contract in midgpt_tpu.quant (the KV
+    grid section): dequantization is bitwise, so an int8 pool behaves
+    like a bf16 pool whose values lie on the grid."""
+
+    k: Array  # [L, NP, Hkv, C, PS] (pool dtype; int8 when quantized)
     v: Array  # [L, NP, Hkv, C, PS]
     page_size: int = static()
+    scale_k: tp.Optional[Array] = None  # [L, NP, Hkv] f32 (int8 pools)
+    scale_v: tp.Optional[Array] = None
 
     @staticmethod
     def init(
         cfg: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
-        mesh=None,
+        mesh=None, kv_quant: tp.Optional[str] = None,
     ) -> "PagedKVPool":
         """``mesh`` (a serving TP mesh): commit the pool KV-head-sharded
         over the 'tensor' axis — each shard holds every page of its own
         Hkv/tp heads (POOL_SPEC_AXES), which is what keeps the serving
-        programs' block-table gathers collective-free."""
+        programs' block-table gathers collective-free. ``kv_quant="int8"``
+        stores the payload int8 with per-(page, KV-head) po2 scale
+        planes (sharded with their heads)."""
         assert num_pages >= 1 and page_size >= 1, (num_pages, page_size)
+        assert kv_quant in (None, "int8"), f"unknown kv_quant {kv_quant!r}"
         shape = (cfg.n_layer, num_pages, cfg.kv_heads, cfg.head_dim, page_size)
+        if kv_quant == "int8":
+            dtype = jnp.int8
         k = jnp.zeros(shape, dtype)
         v = jnp.zeros(shape, dtype)
+        scale_k = scale_v = None
+        if kv_quant == "int8":
+            # scale 1.0 on unwritten pages is inert: a page's scale is
+            # overwritten by its birth write before pooled_len ever
+            # exposes the page to a read
+            scale_k = jnp.ones(shape[:3], jnp.float32)
+            scale_v = jnp.ones(shape[:3], jnp.float32)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -76,18 +110,39 @@ class PagedKVPool:
                 DEFAULT_LOGICAL_RULES,
             )
 
-            spec = P(*[
-                DEFAULT_LOGICAL_RULES.get(a) if a is not None else None
-                for a in POOL_SPEC_AXES
-            ])
-            sharding = NamedSharding(mesh, spec)
-            k = jax.device_put(k, sharding)
-            v = jax.device_put(v, sharding)
-        return PagedKVPool(k=k, v=v, page_size=page_size)
+            def commit(a, axes):
+                spec = P(*[
+                    DEFAULT_LOGICAL_RULES.get(x) if x is not None else None
+                    for x in axes
+                ])
+                return jax.device_put(a, NamedSharding(mesh, spec))
+
+            k = commit(k, POOL_SPEC_AXES)
+            v = commit(v, POOL_SPEC_AXES)
+            if scale_k is not None:
+                scale_k = commit(scale_k, SCALE_SPEC_AXES)
+                scale_v = commit(scale_v, SCALE_SPEC_AXES)
+        return PagedKVPool(
+            k=k, v=v, page_size=page_size, scale_k=scale_k, scale_v=scale_v
+        )
 
     @property
     def num_pages(self) -> int:
         return self.k.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale_k is not None
+
+    @property
+    def row_dtype(self):
+        """The dtype K/V ROWS travel in before they land in pages (the
+        decode window's recent buffers, chunk/verify row outputs). For a
+        float pool this is the pool dtype; for an int8 pool it is bf16 —
+        rows are rounded through the page grid in-dispatch, and grid
+        values (|code| <= 127 times a po2 scale) are exact in bf16, so
+        nothing is lost between the rounding and the page write."""
+        return jnp.bfloat16 if self.quantized else self.k.dtype
 
 
 class PageAllocator:
@@ -363,6 +418,106 @@ def pages_needed(tokens: int, page_size: int) -> int:
     return -(-tokens // page_size)
 
 
+def kv_row_scales(
+    rows_k: Array,  # [S, Hkv, T, C] — contiguous K rows (float dtype)
+    rows_v: Array,  # [S, Hkv, T, C]
+    base: Array,  # [S] int32 — absolute position of row 0 per slot
+    bt: Array,  # [S, Pmax] int32 block tables
+    scale_k_l: Array,  # [NP, Hkv] f32 — ONE layer's pool scale planes
+    scale_v_l: Array,
+    page_size: int,
+) -> tp.Tuple[Array, Array]:
+    """The per-row page-grid scales for a contiguous run of K/V rows:
+    row ``j`` (absolute position ``base + j``) quantizes under the scale
+    of its page, which is (a) derived from the page's BIRTH row when
+    that row sits inside this very batch (positions fill contiguously,
+    so a page entered at ``pos % PS == 0`` was entered by a batch row),
+    else (b) the already-recorded pool scale (the page was born by an
+    earlier dispatch). Returns ``(sk, sv)`` as ``[S, Hkv, T]`` f32.
+
+    This single lookup rule is what makes int8-KV token streams
+    invariant to window size, chunk size, speculation and eviction: a
+    page's scale is a pure function of its birth row's values, and
+    derivation is ROUNDING-STABLE (quant.py), so deriving from rows
+    that were already rounded through their own grid — the state every
+    write path sees — reproduces the original scale bit-for-bit."""
+    s_, hkv, t, c = rows_k.shape
+    ps = page_size
+    pmax = bt.shape[1]
+    npool = scale_k_l.shape[0]
+    pos = base[:, None] + jnp.arange(t, dtype=base.dtype)  # [S, T]
+    page_idx = pos // ps
+    derived_k = kv_scale_from_absmax(
+        jnp.max(jnp.abs(rows_k.astype(jnp.float32)), axis=-1)
+    )  # [S, Hkv, T]
+    derived_v = kv_scale_from_absmax(
+        jnp.max(jnp.abs(rows_v.astype(jnp.float32)), axis=-1)
+    )
+    # in-batch birth row index of row j's page (negative = pre-batch)
+    jb = page_idx * ps - base[:, None]  # [S, T]
+    in_batch = (jb >= 0)[:, None, :]  # [S, 1, T]
+    jb_idx = jnp.broadcast_to(
+        jnp.clip(jb, 0, t - 1)[:, None, :], (s_, hkv, t)
+    )
+    from_batch_k = jnp.take_along_axis(derived_k, jb_idx, axis=-1)
+    from_batch_v = jnp.take_along_axis(derived_v, jb_idx, axis=-1)
+    pg = jnp.take_along_axis(bt, jnp.clip(page_idx, 0, pmax - 1), axis=1)
+    pg = jnp.clip(pg, 0, npool - 1)  # sentinel pads clip like the gather
+    pool_k_s = jnp.transpose(scale_k_l[pg], (0, 2, 1))  # [S, Hkv, T]
+    pool_v_s = jnp.transpose(scale_v_l[pg], (0, 2, 1))
+    sk = jnp.where(in_batch, from_batch_k, pool_k_s)
+    sv = jnp.where(in_batch, from_batch_v, pool_v_s)
+    return sk, sv
+
+
+def _quantize_rows_at_pages(
+    rk: Array,  # [L, S, Hkv, T, C] — contiguous rows, slot-batched
+    rv: Array,
+    scale_k: Array,  # [L, NP, Hkv] f32 — pool scale planes
+    scale_v: Array,
+    base: Array,  # [S] int32 — absolute position of row 0 per slot
+    bt: Array,  # [S, Pmax] int32 block tables
+    pos: Array,  # [S, T] int32 — base[:, None] + arange(T)
+    valid: Array,  # [S, T] bool — row is a real token
+    page_raw: Array,  # [S, T] int32 — row's page (pre sentinel routing)
+    sentinel: int,
+    ps: int,
+) -> tp.Tuple[Array, Array, Array, Array]:
+    """The quantized-write core shared by :func:`flush_recent`,
+    :func:`write_prompt_pages` and :func:`write_token_rows`: derive each
+    row's page-grid scale (``kv_row_scales`` — page-birth rows derive
+    their own, rounding-stable, so rows already rounded in-dispatch
+    re-derive the identical scale; pages continued from an earlier
+    dispatch or a COW copy reuse their recorded pool scale), quantize
+    the rows to exact int8 codes, and scatter the scale planes of pages
+    BORN by this write atomically with their payload — birth rows
+    routed through the same drop sentinel as the payload scatter.
+    Returns ``(qk, qv, scale_k, scale_v)``. Single-slot callers pass
+    S=1 views. This is THE page-birth scale rule: change it here, not
+    in a per-caller copy (a write path quantizing under a divergent
+    rule breaks the scheduling-invariance contract)."""
+    l, s, hkv, t, c = rk.shape
+    sk, sv = jax.vmap(
+        lambda a, b, pk, pv: kv_row_scales(a, b, base, bt, pk, pv, ps)
+    )(rk, rv, scale_k, scale_v)  # [L, S, Hkv, T]
+    qk = quantize_kv_rows(rk, sk)
+    qv = quantize_kv_rows(rv, sv)
+    birth = jnp.where(
+        valid & (pos % ps == 0), page_raw, sentinel
+    ).reshape(-1)  # [S*T]
+    sk_vals = jnp.transpose(sk, (0, 1, 3, 2)).reshape(l, s * t, hkv)
+    sv_vals = jnp.transpose(sv, (0, 1, 3, 2)).reshape(l, s * t, hkv)
+    sk_vals = shard_act(sk_vals, None, None, "kv_heads")
+    sv_vals = shard_act(sv_vals, None, None, "kv_heads")
+    scale_k = shard_act(
+        scale_k.at[:, birth].set(sk_vals, mode="drop"), *SCALE_SPEC_AXES
+    )
+    scale_v = shard_act(
+        scale_v.at[:, birth].set(sv_vals, mode="drop"), *SCALE_SPEC_AXES
+    )
+    return qk, qv, scale_k, scale_v
+
+
 def flush_recent(
     pool: PagedKVPool,
     rk: Array,  # [L, S, Hkv, K, C] — the window's recent rows (time-major)
@@ -395,9 +550,15 @@ def flush_recent(
     np_sentinel = pool.num_pages
     pos = start_len[:, None] + jnp.arange(kk)[None, :]  # [S, K]
     page_idx = jnp.clip(pos // ps, 0, pmax - 1)
-    page = jnp.take_along_axis(bt, page_idx, axis=1)  # [S, K]
-    page = jnp.where(valid, page, np_sentinel)
+    page_raw = jnp.take_along_axis(bt, page_idx, axis=1)  # [S, K]
+    page = jnp.where(valid, page_raw, np_sentinel)
     off = pos % ps
+    scale_k, scale_v = pool.scale_k, pool.scale_v
+    if pool.quantized:
+        rk, rv, scale_k, scale_v = _quantize_rows_at_pages(
+            rk, rv, scale_k, scale_v, start_len, bt, pos, valid,
+            page_raw, np_sentinel, ps
+        )
     # advanced indices at axes 1 and 4 are non-adjacent, so the broadcast
     # [S*K] index dim moves to the FRONT of the updated slice: vals must
     # arrive [S*K, L, Hkv, C]
@@ -417,6 +578,8 @@ def flush_recent(
             vals_v.astype(pool.v.dtype), mode="drop"
         ), *POOL_SPEC_AXES),
         page_size=ps,
+        scale_k=scale_k,
+        scale_v=scale_v,
     )
 
 
@@ -436,6 +599,20 @@ def write_prompt_pages(
     ps = pool.page_size
     assert p % ps == 0, f"prompt length {p} not a multiple of page_size {ps}"
     n = p // ps
+    scale_k, scale_v = pool.scale_k, pool.scale_v
+    if pool.quantized:
+        # page-aligned writes: every written page's birth row is its row
+        # 0, and all births are in-batch (base 0); pages beyond the
+        # allocation already carry the sentinel in page_rows and drop
+        pos = jnp.arange(p, dtype=jnp.int32)
+        page_raw = page_rows[pos // ps]
+        qk, qv, scale_k, scale_v = _quantize_rows_at_pages(
+            ks[:, None], vs[:, None], scale_k, scale_v,
+            jnp.zeros((1,), jnp.int32), page_rows[None], pos[None],
+            jnp.ones((1, p), bool), page_raw[None], pool.num_pages, ps
+        )
+        ks, vs = qk[:, 0], qv[:, 0]
+
     # [L, Hkv, P, C] -> time-minor page blocks [L, n, Hkv, C, PS]
     def to_pages(a):
         a = jnp.transpose(a, (0, 1, 3, 2))  # [L, Hkv, C, P]
@@ -450,6 +627,8 @@ def write_prompt_pages(
             to_pages(vs).astype(pool.v.dtype), mode="drop"
         ), *POOL_SPEC_AXES),
         page_size=ps,
+        scale_k=scale_k,
+        scale_v=scale_v,
     )
 
 
@@ -473,8 +652,20 @@ def write_token_rows(
     pos = start + jnp.arange(t)  # [T]
     valid = jnp.arange(t) < n_valid
     page_idx = jnp.clip(pos // ps, 0, pmax - 1)
-    page = jnp.where(valid, bt_row[page_idx], pool.num_pages)
+    page_raw = bt_row[page_idx]
+    page = jnp.where(valid, page_raw, pool.num_pages)
     off = pos % ps
+    scale_k, scale_v = pool.scale_k, pool.scale_v
+    if pool.quantized:
+        # chunk boundaries need not page-align: a page born mid-chunk
+        # derives from its in-batch birth row, a page continued from an
+        # earlier chunk (or a COW copy) reuses its recorded pool scale
+        qk, qv, scale_k, scale_v = _quantize_rows_at_pages(
+            ks[:, None], vs[:, None], scale_k, scale_v,
+            start[None].astype(jnp.int32), bt_row[None], pos[None],
+            valid[None], page_raw[None], pool.num_pages, ps
+        )
+        ks, vs = qk[:, 0], qv[:, 0]
     # advanced indices at axes 1 and 4 are non-adjacent: the broadcast
     # [T] index dim moves to the FRONT — vals arrive [T, L, Hkv, C]
     vals_k = shard_act(jnp.transpose(ks, (2, 0, 1, 3)), None, None,
@@ -489,6 +680,8 @@ def write_token_rows(
             vals_v.astype(pool.v.dtype), mode="drop"
         ), *POOL_SPEC_AXES),
         page_size=ps,
+        scale_k=scale_k,
+        scale_v=scale_v,
     )
 
 
@@ -497,7 +690,18 @@ def copy_page(pool: PagedKVPool, src: Array, dst: Array) -> PagedKVPool:
     a request admitted onto a partially-shared cached page gets a private
     copy it may append into, leaving the shared original untouched. One
     dynamic slice + update per pool array; donate the pool when jitting
-    (the engine's compiled wrapper does)."""
+    (the engine's compiled wrapper does).
+
+    Int8 pools: the per-(page, KV-head) scale rows copy IN THE SAME
+    jitted program as the payload — a page and its scale are one atomic
+    unit. Copying only the codes would leave the destination decoding
+    the cached prefix's values under a stale scale, and because rounding
+    is deterministic, the corruption would be silent and bit-stable
+    (tests pin the prefix-cache-hit-under-kv-quant identity). The COW
+    destination also inherits the source's scale for the rows the
+    admitted request APPENDS into the copied page — correct by the
+    page-birth contract: a page's scale is fixed at birth, and the copy
+    shares the original's birth row."""
     # no shard_act pins here: the engine jits copy_page OUTSIDE any
     # axis_rules scope (one mesh-free wrapper shared by every engine),
     # where shard_act is a no-op by construction. Sharding under TP
@@ -507,8 +711,20 @@ def copy_page(pool: PagedKVPool, src: Array, dst: Array) -> PagedKVPool:
     # donated buffer aliases because nothing reshards.
     k_row = jax.lax.dynamic_slice_in_dim(pool.k, src, 1, axis=1)
     v_row = jax.lax.dynamic_slice_in_dim(pool.v, src, 1, axis=1)
+    scale_k, scale_v = pool.scale_k, pool.scale_v
+    if pool.quantized:
+        sk_row = jax.lax.dynamic_slice_in_dim(scale_k, src, 1, axis=1)
+        sv_row = jax.lax.dynamic_slice_in_dim(scale_v, src, 1, axis=1)
+        scale_k = jax.lax.dynamic_update_slice_in_dim(
+            scale_k, sk_row, dst, axis=1
+        )
+        scale_v = jax.lax.dynamic_update_slice_in_dim(
+            scale_v, sv_row, dst, axis=1
+        )
     return PagedKVPool(
         k=jax.lax.dynamic_update_slice_in_dim(pool.k, k_row, dst, axis=1),
         v=jax.lax.dynamic_update_slice_in_dim(pool.v, v_row, dst, axis=1),
         page_size=pool.page_size,
+        scale_k=scale_k,
+        scale_v=scale_v,
     )

@@ -102,6 +102,21 @@ def main() -> None:
     ap.add_argument("--repetitive", action="store_true",
                     help="tile each prompt from a short random pattern — "
                     "the self-repeating workload n-gram drafting targets")
+    ap.add_argument("--kv_quant", choices=("on", "off"), default="off",
+                    help="int8-quantized paged KV pool (serving.paged): "
+                    "page payloads store int8 with one f32 po2 scale "
+                    "per (page, KV-head) plane, halving the K+V HBM "
+                    "stream every decode step pays — the largest "
+                    "remaining stream after --quant halves the weights "
+                    "(PERF.md floor decomposition)")
+    ap.add_argument("--paged_kernel", choices=("auto", "pallas", "xla"),
+                    default="auto",
+                    help="paged-attention backend: 'pallas' walks each "
+                    "slot's block table IN-KERNEL over its ragged "
+                    "length (ops.paged_attn — pages stream from HBM "
+                    "once, no gathered [S, Pmax*PS, ...] intermediate), "
+                    "'xla' keeps the gather path, 'auto' = pallas on "
+                    "TPU when the VMEM assembly fits")
     ap.add_argument("--quant", choices=("on", "off"), default="off",
                     help="serve the int8 per-channel quantized weight "
                     "path (midgpt_tpu.quant): dequant fused into each "
@@ -211,6 +226,8 @@ def main() -> None:
         prefix_cache=args.prefix_cache == "on",
         prefill_chunk=args.prefill_chunk or None,
         speculate=args.spec_len if args.spec == "on" else 0,
+        kv_quant="int8" if args.kv_quant == "on" else None,
+        paged_kernel=args.paged_kernel,
     )
     meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
     if args.dp_replicas > 1:
@@ -323,7 +340,8 @@ def main() -> None:
     )
     static = floor_decomposition(
         cfg, slots=args.slots, live_tokens=live_mean,
-        quant=args.quant == "on", tp_degree=args.tp,
+        quant=args.quant == "on", kv_quant=args.kv_quant == "on",
+        page_size=args.page_size, tp_degree=args.tp,
     )
 
     ttfts = sorted(
@@ -341,7 +359,9 @@ def main() -> None:
             f"sys={args.sys_prompt_len} "
             f"spec={args.spec_len if args.spec == 'on' else 'off'}"
             f"{' rep' if args.repetitive else ''}"
-            f" quant={args.quant} tp={args.tp} dp={args.dp_replicas}"
+            f" quant={args.quant} kv_quant={args.kv_quant}"
+            f" kernel={engines[0].paged_kernel}"
+            f" tp={args.tp} dp={args.dp_replicas}"
         ),
         "serve_tp": args.tp,
         "serve_dp_replicas": args.dp_replicas,
@@ -349,6 +369,8 @@ def main() -> None:
         "serve_comms_by_axis": comms_by_axis,
         "serve_comms_collective_count": comms_count,
         "serve_quant": args.quant,
+        "serve_kv_quant": args.kv_quant,
+        "serve_paged_kernel": engines[0].paged_kernel,
         "serve_peak_hbm_bytes": peak_hbm,
         "serve_bytes_per_token_static": static["bytes_per_token"],
         "serve_bytes_per_step_static": static["bytes_per_step"],

@@ -108,6 +108,27 @@ run serving_tp2_dp2 python scripts/bench_serving.py --platform=tpu \
   --tp 2 --dp_replicas 2 --out artifacts/bench_serving_tp2_dp2.json
 run serving_tp4_quant python scripts/bench_serving.py --platform=tpu \
   --tp 4 --quant on --out artifacts/bench_serving_tp4_quant.json
+# Pallas ragged paged-attention kernel + int8 KV pool (PR 9): the same
+# B=8 trace across the 2x2 (kernel x kv-quant) cell grid, int8 weights
+# throughout (the production serving precision). The kernel removes the
+# XLA page-gather intermediate (the K+V stream crosses HBM once instead
+# of ~3x), kv-quant halves the bytes themselves: PERF.md's corrected
+# decomposition puts the int8-weights floor at ~0.39 ms/step with bf16
+# KV (0.155 w + 0.236 kv) and ~0.27 with int8 KV (0.155 + 0.118) — the
+# realized ms/tok of each cell lands next to those static floors
+# (serve_hbm_floor_ms_static is recorded in-band per rung).
+run serving_kernel_off_kvq_off python scripts/bench_serving.py \
+  --platform=tpu --quant on --paged_kernel xla --kv_quant off \
+  --out artifacts/bench_serving_kernel_off_kvq_off.json
+run serving_kernel_on_kvq_off python scripts/bench_serving.py \
+  --platform=tpu --quant on --paged_kernel pallas --kv_quant off \
+  --out artifacts/bench_serving_kernel_on_kvq_off.json
+run serving_kernel_off_kvq_on python scripts/bench_serving.py \
+  --platform=tpu --quant on --paged_kernel xla --kv_quant on \
+  --out artifacts/bench_serving_kernel_off_kvq_on.json
+run serving_kernel_on_kvq_on python scripts/bench_serving.py \
+  --platform=tpu --quant on --paged_kernel pallas --kv_quant on \
+  --out artifacts/bench_serving_kernel_on_kvq_on.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

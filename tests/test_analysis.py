@@ -435,6 +435,7 @@ def test_train_step_comms_summary_scalars():
     json.dumps(s)  # JSON-serializable scalars
 
 
+@pytest.mark.slow
 def test_cli_injected_batch_gather_fails_audit(tmp_path, capsys):
     """Acceptance: a bad PartitionSpec (batch logical axis mapped to
     nothing — the opaque-boundary trap) makes the CLI emit a

@@ -150,7 +150,7 @@ def test_normalized_trace_drops_structure_keeps_dtypes():
 
 def _cast_early_prefill_paged_at(
     self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
-    sin_rows, cos_rows,
+    sin_rows, cos_rows, **_new_kwargs,
 ):
     """prefill_paged_at with the HISTORICAL PR 4 drift re-injected:
     f32 upcast before the score einsums and f32 probs through the PV
@@ -231,7 +231,7 @@ def test_prover_catches_cast_early_prefill(monkeypatch):
 
 def _prefill_flavored_verify_paged_at(
     self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
-    sin_rows, cos_rows,
+    sin_rows, cos_rows, **_new_kwargs,
 ):
     """verify_paged_at as PR 5's FIRST CUT wrote it: the prefill
     chunk's choreography (bf16 score einsums with f32 accumulation,
@@ -311,7 +311,7 @@ def test_prover_catches_prefill_flavored_verify(monkeypatch):
 
 def _scale_before_mask_decode_paged_at(
     self, x, pool_k, pool_v, bt, rk, rv, layer, r, mask_pool, mask_rec,
-    sin_rows, cos_rows,
+    sin_rows, cos_rows, **_new_kwargs,
 ):
     """decode_paged_at with the softmax argument order flipped: scores
     are scaled BEFORE the additive mask lands, so the -inf mask is
@@ -385,5 +385,93 @@ def test_prover_catches_scale_before_mask(monkeypatch):
         checks["verify-mirrors-decode"] is False
         or checks[
             "shared: mask is added before the softmax scale everywhere"
+        ] is False
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged-attention kernel as a contract node (PR 9)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kernel_report():
+    return prove_serving_choreography("openwebtext", paged_kernel="pallas")
+
+
+def test_prover_passes_on_kernel_path(kernel_report):
+    assert kernel_report.ok, "\n".join(
+        f"{c.name}: {c.detail}"
+        for c in kernel_report.checks
+        if not c.ok
+    )
+    progs = {p.name: p for p in kernel_report.programs}
+    # decode and verify run INSIDE the kernel; the prefill chunk stays
+    # on the XLA einsum path (compute-bound, naive-contract)
+    assert progs["decode_window"].kernelized
+    assert progs["verify"].kernelized
+    assert not progs["prefill_chunk"].kernelized
+
+
+def test_kernel_node_is_one_record_and_bodies_match_decode_contract(
+    kernel_report,
+):
+    """The kernel appears as a single 'paged_kernel' contract node in
+    the attention traces (not as inlined internals), decode == verify
+    op for op across it, and the KERNEL BODY's softmax signature equals
+    the XLA decode window's — same f32 accumulation, mask-before-scale,
+    f32 softmax, f32 probs through PV."""
+    progs = {p.name: p for p in kernel_report.programs}
+    dec = progs["decode_window"]
+    kinds = [rec[0] for rec in dec.attention]
+    assert kinds.count("paged_kernel") == 1
+    assert dec.attention == progs["verify"].attention
+    xla = prove_serving_choreography("openwebtext")
+    xla_dec = {p.name: p for p in xla.programs}["decode_window"]
+    assert dec.softmax == xla_dec.softmax
+
+
+def test_prover_proves_kv_dequant_contract():
+    rep = prove_serving_choreography(
+        "openwebtext", kv_quant=True, paged_kernel="pallas"
+    )
+    assert rep.ok, "\n".join(
+        f"{c.name}: {c.detail}" for c in rep.checks if not c.ok
+    )
+    for p in rep.programs:
+        if p.name != "naive_reference":
+            assert p.kv_dequant, p.name
+    # and the float-pool trace must NOT carry a stray dequant
+    rep2 = prove_serving_choreography("openwebtext", paged_kernel="pallas")
+    for p in rep2.programs:
+        assert not p.kv_dequant, p.name
+
+
+def test_prover_catches_bf16_accumulating_kernel(monkeypatch):
+    """Fault injection: a kernel variant that accumulates QK scores in
+    bf16 (SCORE_ACC_DTYPE is the kernels' contract point) must turn the
+    prover red. The failure lands on the extraction-degeneracy guard:
+    jnp silently RE-PROMOTES half-precision reductions, so the faulty
+    kernel's score chain grows convert hops that break the signature
+    walk — and a signature the prover can no longer read is a
+    violation, never a vacuous pass (this exact fault used to slip
+    through before the guard existed)."""
+    from midgpt_tpu.ops import paged_attn
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(paged_attn, "SCORE_ACC_DTYPE", jnp.bfloat16)
+    try:
+        rep = prove_serving_choreography(
+            "openwebtext", paged_kernel="pallas"
+        )
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert (
+        checks["shared: scores accumulate in f32 everywhere"] is False
+        or checks[
+            "shared: every program exposes its score contractions "
+            "to the prover"
         ] is False
     )

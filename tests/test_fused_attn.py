@@ -167,6 +167,7 @@ def test_packed_qkv_matches_split(h, hkv, c):
     np.testing.assert_allclose(np.asarray(gp[2]), np.asarray(gs[4]), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_under_data_sharded_mesh():
     """The fused path under a live replica x fsdp mesh runs per-shard via
     shard_map (models/gpt.py _fused_attention_sharded): forward and grads
@@ -213,6 +214,7 @@ def test_fused_under_data_sharded_mesh():
         )
 
 
+@pytest.mark.slow
 def test_fused_under_tensor_sharded_mesh():
     """TP + fused: tensor shards the head dim; each shard runs the
     split-entry kernel with H/tp heads (models/gpt.py

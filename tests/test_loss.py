@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from midgpt_tpu.config import ExperimentConfig, MeshConfig, ModelConfig
 from midgpt_tpu.models.gpt import GPT
@@ -63,6 +64,7 @@ def test_loss_fn_chunked_matches_dense_through_model():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_train_step_with_loss_chunk_sharded(mesh8):
     """One sharded train step with loss_chunk on vs off: same loss. The
     first mesh has sequence=2, so this drives the per-shard chunked path

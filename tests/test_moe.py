@@ -129,6 +129,7 @@ def test_moe_overflow_reports_dropped_fraction():
     np.testing.assert_allclose(float(dropped), 31 / 32, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_gpt_stats_pass():
     """GPT.moe_stats: one deterministic forward returning the summed aux
     and mean dropped fraction the trainer logs per eval interval."""
@@ -156,6 +157,7 @@ def test_moe_gpt_forward_and_aux():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
 
+@pytest.mark.slow
 def test_moe_trains_and_router_gets_gradients():
     from midgpt_tpu.parallel.mesh import create_mesh
     from midgpt_tpu.parallel.sharding import make_global_array
@@ -186,6 +188,7 @@ def test_moe_trains_and_router_gets_gradients():
     assert not np.allclose(r0, r1)  # aux + gate path reach the router
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_single_device(mesh8):
     """ep: experts sharded over 'tensor' (GPT_PARAM_RULES) — the sharded
     loss must match the unsharded one."""
@@ -357,6 +360,7 @@ def test_moe_top2_balanced_router_aux_is_one():
     np.testing.assert_allclose(float(aux), 1.0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_top2_trains_and_balances():
     from midgpt_tpu.parallel.mesh import create_mesh
     from midgpt_tpu.parallel.sharding import make_global_array
@@ -383,6 +387,7 @@ def test_moe_top2_trains_and_balances():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_moe_top2_ep_parity(mesh8):
     """Top-2 under the expert-parallel mesh matches single-device."""
     from midgpt_tpu.parallel.mesh import create_mesh

@@ -121,6 +121,7 @@ def _run_gpt_step(model_cfg, mesh_cfg, n_dev, x, y):
     return float(loss), state
 
 
+@pytest.mark.slow
 def test_gpt_pp_train_step_matches_non_pp():
     """VERDICT r1 item 4: a real GPT train step with the block stack
     pipelined over 4 stages must produce the same loss as the plain
@@ -159,6 +160,7 @@ def test_gpt_pp_train_step_matches_non_pp():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt_pp_composes_with_tensor_parallel():
     """PP x TP x FSDP on 8 devices: the partial-auto shard_map leaves the
     tensor/fsdp axes to GSPMD inside the stages; loss must still match the
@@ -225,6 +227,7 @@ def test_gpt_pp_with_grad_accumulation():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gpt_pp_with_dropout():
     """Dropout under PP (r3 left this deterministic-only): keys thread
     through the tick schedule next to the params. Checks: the step runs
@@ -279,6 +282,7 @@ def test_gpt_pp_with_dropout():
     assert l_d1 != l_det  # dropout actually perturbs the forward
 
 
+@pytest.mark.slow
 def test_gpt_pp_flash_runs_at_parity(pallas_interpret):
     """Flash attention inside pipeline stages (ADVICE r4): the stage region
     is check_vma=True, so the kernel's out_shapes must carry the operands'

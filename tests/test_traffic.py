@@ -319,3 +319,72 @@ def test_model_closure_trips_budget_gate():
     violations = check_budget(bad, budget)
     assert any("weights stream" in v for v in violations), violations
     assert any("constant" in v for v in violations), violations
+
+
+# ---------------------------------------------------------------------------
+# int8-quantized KV pool cells (PR 9)
+# ---------------------------------------------------------------------------
+
+
+def test_kv8_budget_cells_exist_for_every_program():
+    from midgpt_tpu.analysis.budgets import precision_key
+
+    for prog in ("decode_window", "prefill_chunk", "verify_program"):
+        for prec in ("bf16", "int8"):
+            for geom in ("single", "replica2,tensor2"):
+                cell = budget_for(prog, precision_key(prec, True), geom)
+                assert cell is not None, (prog, prec, geom)
+                assert "kv" in cell and "constants_max" in cell
+
+
+def test_kv8_cells_carry_half_the_bf16_kv_stream():
+    """The point of the int8 pool, in budget arithmetic: every kv8 cell's
+    KV stream is the bf16 cell's payload halved plus the f32
+    per-(page, KV-head) scale planes — and the scale overhead is small
+    (< 1% of the payload at the audit geometry). The bf16 cells are
+    untouched."""
+    from midgpt_tpu.analysis.budgets import precision_key
+
+    for prog in ("decode_window", "prefill_chunk", "verify_program"):
+        for geom in ("single", "replica2,tensor2"):
+            for prec in ("bf16", "int8"):
+                base = budget_for(prog, prec, geom)
+                kv8 = budget_for(prog, precision_key(prec, True), geom)
+                scales = kv8["kv"] - base["kv"] // 2
+                assert 0 < scales < base["kv"] // 100, (
+                    prog, prec, geom, kv8["kv"], base["kv"]
+                )
+                # weights are orthogonal: kv-quant must not move them
+                assert kv8["weights"] == base["weights"]
+
+
+def test_precision_key():
+    from midgpt_tpu.analysis.budgets import precision_key
+
+    assert precision_key("bf16") == "bf16"
+    assert precision_key("int8", False) == "int8"
+    assert precision_key("bf16", True) == "bf16-kv8"
+    assert precision_key("int8", True) == "int8-kv8"
+
+
+def test_floor_decomposition_kv_quant_halves_kv_stream():
+    """The analytic roofline with the int8 pool: KV bytes drop to half
+    plus the per-page scale term, moving the 124M B=8 int8-weights floor
+    from ~0.39 (0.155 w + 0.236 kv) toward ~0.27 ms/step (0.155 +
+    0.118) — the PR 9 target arithmetic (PERF.md)."""
+    cfg = get_config("openwebtext").model
+    base = floor_decomposition(cfg, slots=8, live_tokens=640, quant=True)
+    kv8 = floor_decomposition(
+        cfg, slots=8, live_tokens=640, quant=True, kv_quant=True
+    )
+    assert kv8["kv_quant"] is True
+    payload_half = base["kv_bytes_per_step"] // 2
+    scales = kv8["kv_bytes_per_step"] - payload_half
+    assert 0 < scales < base["kv_bytes_per_step"] // 50
+    assert kv8["weights_bytes_per_step"] == base["weights_bytes_per_step"]
+    # the headline: int8 weights + int8 KV lands near the ~0.27 floor
+    assert abs(kv8["floor_ms_per_step"] - 0.28) < 0.03
+    assert abs(base["floor_ms_per_step"] - 0.39) < 0.03
+    # the floor table renders the kv8 tag
+    table = floor_table_markdown([kv8])
+    assert "kv8" in table

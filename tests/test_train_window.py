@@ -121,6 +121,7 @@ def test_train_fails_fast_before_any_heavy_work(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_window_reproduces_k1_losses_bitwise(mesh8):
     """8 steps as two K=4 fused windows reproduce the 8 single-dispatch
     steps bit-for-bit: per-step losses AND the full updated state."""
@@ -204,6 +205,7 @@ def test_window_metrics_are_scan_outputs_no_host_sync(mesh8):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_cli_audit_of_fused_window_exits_zero(tmp_path, capsys):
     """Acceptance: the analysis CLI compiles the REAL fused K=4 window
     (make_train_window) for the shipped 124M config and every rule passes
