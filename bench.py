@@ -218,13 +218,18 @@ def _rung_measure(cfg, state, chain, make_scan):
         return tps, step_ms, state, "chained"
 
 
-def _emit_bench_error(msg: str) -> None:
+def _emit_bench_error(msg: str, status: str = "error") -> None:
     """The driver parses bench output mechanically — every failure mode
-    must still print the one-JSON-line contract."""
+    must still print the one-JSON-line contract. ``status`` makes the
+    failure MODE machine-readable: "watchdog" rows are hardware wedges
+    (the r4/r5 BENCH rows — a stuck TPU relay, not a regression);
+    "error" rows are real failures. Trajectory tooling reading
+    BENCH_r*.json can then separate the two instead of treating every
+    bad round as a perf cliff."""
     print(
         json.dumps({
             "metric": "bench_error", "value": 0, "unit": "none",
-            "vs_baseline": 0, "error": msg[:400],
+            "vs_baseline": 0, "status": status, "error": msg[:400],
         }),
         flush=True,
     )
@@ -253,7 +258,8 @@ def _backend_watchdog(timeout_s: float = 600.0):
             if done.is_set():  # init finished right at the boundary: the
                 return  # main thread owns the output line (ADVICE r4)
             _emit_bench_error(
-                f"backend init exceeded {timeout_s:.0f}s (wedged TPU relay?)"
+                f"backend init exceeded {timeout_s:.0f}s (wedged TPU relay?)",
+                status="watchdog",
             )
             sys.stderr.write("bench watchdog: backend init hung; exiting\n")
             os._exit(3)
@@ -277,13 +283,15 @@ def _progress_watchdog(record: dict, done, deadline_s: float = 900.0):
             return  # normal completion owns the output line
         if "value" in record:
             record["partial"] = True
+            record["status"] = "watchdog"
             print(json.dumps(record), flush=True)
             sys.stderr.write(
                 "bench watchdog: mid-run hang; emitted partial record\n"
             )
             os._exit(0)
         _emit_bench_error(
-            f"no rung completed within {deadline_s:.0f}s (relay wedge?)"
+            f"no rung completed within {deadline_s:.0f}s (relay wedge?)",
+            status="watchdog",
         )
         os._exit(4)
 
@@ -554,6 +562,7 @@ def main() -> None:
     _all_done.set()  # cancel the mid-run watchdog: main owns the output
     if "value" not in record:
         raise RuntimeError(f"no bench config ran: {record}")
+    record.setdefault("status", "ok")
     print(json.dumps(record))
 
 

@@ -28,7 +28,14 @@ Public surface:
   shards its model/KV pool over a tensor-only mesh
   (``ServingEngine(mesh=...)``, whole-KV-head pool sharding), and the
   cluster runs N shared-nothing engine replicas (least-loaded admission,
-  per-replica prefix caches, aggregated stats) above it.
+  per-replica prefix caches, aggregated stats) above it — with
+  per-replica health, a dispatch watchdog, transient-error retry, and
+  bit-identical failover of a dead replica's backlog.
+- :class:`~midgpt_tpu.serving.faults.FaultPlan` and the typed failure
+  surface (:class:`~midgpt_tpu.serving.faults.AdmissionRejected`,
+  :class:`~midgpt_tpu.serving.faults.PoolOverloaded`, the replica fault
+  exceptions) — deterministic, scripted chaos injection keyed to
+  scheduler-step boundaries, replayable bit for bit.
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -40,6 +47,17 @@ import typing as tp
 import numpy as np
 
 from midgpt_tpu.serving.cluster import ServingCluster, serving_meshes
+from midgpt_tpu.serving.faults import (
+    AdmissionRejected,
+    ClusterUnavailable,
+    FaultEvent,
+    FaultPlan,
+    PoolOverloaded,
+    ReplicaCrash,
+    ServingFault,
+    TransientDispatchError,
+    WedgedDispatch,
+)
 from midgpt_tpu.serving.engine import (
     Request,
     ServingEngine,
@@ -61,14 +79,23 @@ from midgpt_tpu.serving.paged import (
 )
 
 __all__ = [
+    "AdmissionRejected",
+    "ClusterUnavailable",
+    "FaultEvent",
+    "FaultPlan",
     "NgramProposer",
     "PageAllocator",
     "PagedKVPool",
+    "PoolOverloaded",
     "PrefixIndex",
     "Proposer",
+    "ReplicaCrash",
     "Request",
     "ServingCluster",
     "ServingEngine",
+    "ServingFault",
+    "TransientDispatchError",
+    "WedgedDispatch",
     "copy_page",
     "serving_meshes",
     "flush_recent",

@@ -1,5 +1,5 @@
 """Shared-nothing data-parallel serving: N independent engine replicas
-under one admission scheduler.
+under one admission scheduler, with failover.
 
 Tensor parallelism (``ServingEngine(mesh=...)``) scales a single engine
 DOWN the latency axis — the model's weights and KV pool split over the
@@ -16,11 +16,32 @@ this TPxDP composition).
 :class:`ServingCluster` is the scheduler above the replicas:
 
 - **Least-loaded admission**: ``submit`` routes each request to the
-  replica with the smallest backlog (queued + active requests;
+  healthy replica with the smallest backlog (queued + active requests;
   deterministic lowest-index tie-break). Because every engine's token
   stream is a function of the request alone (the determinism contract in
   ``serving.engine``), placement NEVER changes a request's tokens — only
   its latency — which the cluster test asserts directly.
+- **Per-replica health + failover** (serving.faults): every replica is
+  ``healthy``, ``suspect``, or ``dead``. A wall-clock dispatch watchdog
+  (``dispatch_timeout_s``) catches the wedged-relay case (the r4/r5
+  BENCH post-mortems: a dispatch that never returns); a
+  ``TransientDispatchError`` is retried on the same replica with capped
+  exponential backoff (``max_retries``/``backoff_s``/``backoff_cap_s``,
+  suspect while retrying); a ``ReplicaCrash``, a watchdog trip, or
+  exhausted retries mark the replica DEAD and its backlog fails over —
+  WARM when the replica's step thread provably completed by raising
+  (the engine drains exactly: in-flight slots convert through the
+  bit-identical eviction path, progress preserved), COLD on a watchdog
+  trip (the thread may still be running, so the engine is never
+  touched again and its requests re-serve from scratch off the
+  cluster's submission record). Failures are processed only after
+  every replica's step has settled, so failover never mutates an
+  engine mid-step. **Failover replay is bit-identical** either way:
+  scripted faults fire at step boundaries (before any dispatch mutates
+  state), re-queueing rides the eviction path or the determinism
+  contract, and placement invariance makes the surviving stream equal
+  to the fault-free run token for token — the chaos suite proves it,
+  not just asserts it plausible.
 - **Per-replica prefix caches**: no cross-replica page sharing (pages
   live in per-replica pools on disjoint devices). A shared-prefix mix
   therefore hits best when co-located; the least-loaded policy is
@@ -28,21 +49,44 @@ this TPxDP composition).
   plug-in point, not an engine change.
 - **Aggregated stats**: :meth:`stats` sums the per-engine counters and
   keeps the per-replica breakdown, in the same key layout as
-  ``ServingEngine.stats`` (bench_serving emits it unchanged).
+  ``ServingEngine.stats`` (bench_serving emits it unchanged), plus the
+  cluster-level failover counters (watchdog trips, retries, failovers,
+  re-queued requests, replica health).
 
 This is the seam the async front door (ROADMAP item 5) slots into:
 streaming/cancellation/priorities wrap ``submit``/``step`` here without
-touching the engines.
+touching the engines — and the health/failover layer beneath it is what
+lets that front door promise SLOs.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import time
 import typing as tp
 
 import numpy as np
 
 from midgpt_tpu.serving.engine import Request, ServingEngine
+from midgpt_tpu.serving.faults import (
+    AdmissionRejected,
+    ClusterUnavailable,
+    FaultPlan,
+    PoolOverloaded,
+    ReplicaCrash,
+    TransientDispatchError,
+    WedgedDispatch,
+)
+
+
+class _WatchdogTrip(Exception):
+    """Internal marker: the cluster's wall-clock wait on a replica step
+    expired with the step thread STILL RUNNING. Never raised by engine
+    code — it exists to distinguish a true watchdog trip (cold,
+    engine-abandoning failover) from an organic ``TimeoutError`` raised
+    inside step(), which on Python 3.11+ is the same class as
+    ``concurrent.futures.TimeoutError`` (thread completed → warm
+    failover, like any crash)."""
 
 
 def serving_meshes(
@@ -81,8 +125,8 @@ def serving_meshes(
 
 class ServingCluster:
     """N shared-nothing :class:`ServingEngine` replicas + least-loaded
-    admission. The cluster's request ids are its own (monotone, globally
-    unique); per-replica ids stay internal.
+    admission + health-tracked failover. The cluster's request ids are
+    its own (monotone, globally unique); per-replica ids stay internal.
 
     ``meshes`` pins each replica to its own mesh (``serving_meshes``
     builds the standard TPxDP split); ``replicas=N`` without meshes runs
@@ -90,6 +134,22 @@ class ServingCluster:
     scheduler-correctness configuration the tests drive, and the
     single-host shape the async front door (ROADMAP item 5) will
     multiplex. All other keyword arguments go to every engine verbatim.
+
+    Fault-tolerance knobs:
+
+    - ``dispatch_timeout_s`` — wall-clock watchdog per replica step;
+      ``None`` (default) disables it. A trip marks the replica dead
+      (its dispatch may never return — re-using it would double-serve)
+      and fails its backlog over.
+    - ``max_retries`` / ``backoff_s`` / ``backoff_cap_s`` — capped
+      exponential backoff for :class:`TransientDispatchError`
+      (``sleep(min(backoff_s * 2**attempt, backoff_cap_s))`` before each
+      retry); the replica rides ``suspect`` while retrying and returns
+      ``healthy`` on success.
+    - ``fault_plan`` — a :class:`~midgpt_tpu.serving.faults.FaultPlan`;
+      each replica gets its own scripted hook
+      (``plan.hook(replica_index)``), making whole-cluster chaos runs
+      replayable bit for bit.
     """
 
     def __init__(
@@ -98,6 +158,11 @@ class ServingCluster:
         *,
         replicas: tp.Optional[int] = None,
         meshes: tp.Optional[tp.Sequence] = None,
+        fault_plan: tp.Optional[FaultPlan] = None,
+        dispatch_timeout_s: tp.Optional[float] = None,
+        max_retries: int = 3,
+        backoff_s: float = 0.05,
+        backoff_cap_s: float = 1.0,
         **engine_kwargs,
     ):
         if meshes is None:
@@ -111,11 +176,37 @@ class ServingCluster:
                 f"replicas={replicas} contradicts {len(meshes)} meshes"
             )
         assert len(meshes) >= 1
-        self.engines: tp.List[ServingEngine] = [
-            ServingEngine(model, mesh=m, **engine_kwargs) for m in meshes
-        ]
+        assert max_retries >= 0 and backoff_s >= 0.0, (
+            max_retries, backoff_s,
+        )
+        self.engines: tp.List[ServingEngine] = []
+        for i, m in enumerate(meshes):
+            kw = dict(engine_kwargs)
+            if fault_plan is not None:
+                kw["fault_hook"] = fault_plan.hook(i)
+            self.engines.append(ServingEngine(model, mesh=m, **kw))
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        # per-replica health: healthy -> suspect (retrying a transient)
+        # -> healthy, or -> dead (crash / watchdog trip / retries
+        # exhausted). Dead is terminal: the backlog failed over, and a
+        # wedged dispatch may still hold the old engine's buffers.
+        self.health: tp.List[str] = ["healthy"] * len(self.engines)
+        self.health_reason: tp.List[tp.Optional[str]] = (
+            [None] * len(self.engines)
+        )
+        self.watchdog_trips = 0
+        self.retries = 0
+        self.failovers = 0
+        self.requeued_requests = 0
+        self.first_fault_time: tp.Optional[float] = None
         # global rid -> (replica index, engine-local rid)
         self._route: tp.Dict[int, tp.Tuple[int, int]] = {}
+        # global rid -> (prompt, max_new_tokens, eos_id, seed): the cold
+        # failover record (dropped at harvest)
+        self._submitted: tp.Dict[int, tp.Tuple] = {}
         self._next_rid = 0
         self.finished: tp.Dict[int, Request] = {}
         # one stepping thread per replica: ServingEngine.step blocks on
@@ -125,13 +216,18 @@ class ServingCluster:
         # share no state (that is the design), jax dispatch/blocking
         # reads release the GIL, and each engine only ever runs on ONE
         # thread at a time (submit/step/run are driven from the caller's
-        # thread; the pool just fans one step() per engine out).
+        # thread; the pool just fans one step() per engine out). The
+        # watchdog also needs the pool (a timeout requires stepping on a
+        # thread the caller can abandon), so a single replica gets one
+        # when dispatch_timeout_s is set. Workers are over-provisioned:
+        # a wedged step occupies its worker until the stall ends, and
+        # retries/failover must still find a free thread meanwhile.
         self._pool = (
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=len(self.engines),
+                max_workers=max(4, 2 * len(self.engines)),
                 thread_name_prefix="serving-replica",
             )
-            if len(self.engines) > 1
+            if len(self.engines) > 1 or dispatch_timeout_s is not None
             else None
         )
 
@@ -139,12 +235,29 @@ class ServingCluster:
     def replicas(self) -> int:
         return len(self.engines)
 
+    def _alive(self) -> tp.List[int]:
+        return [
+            i for i in range(len(self.engines)) if self.health[i] != "dead"
+        ]
+
+    @property
+    def has_work(self) -> bool:
+        """Un-harvested cluster requests remain. Routes outlive replica
+        deaths (failover re-points them at survivors), so this is the
+        drain condition even mid-failover."""
+        return bool(self._route) or any(
+            self.engines[i].has_work for i in self._alive()
+        )
+
     def _load(self, e: ServingEngine) -> int:
-        """Backlog of one replica: queued + in-flight requests. Counting
-        requests (not tokens) keeps admission O(1) and deterministic;
-        remaining-token estimates are a policy refinement the seam
-        allows."""
-        return len(e.queue) + len(e._active_slots())
+        """Backlog of one replica: queued + parked + in-flight requests.
+        Counting requests (not tokens) keeps admission O(1) and
+        deterministic; remaining-token estimates are a policy refinement
+        the seam allows."""
+        return len(e.queue) + len(e.parked) + len(e._active_slots())
+
+    def _least_loaded(self, alive: tp.Sequence[int]) -> int:
+        return min(alive, key=lambda j: (self._load(self.engines[j]), j))
 
     def submit(
         self,
@@ -154,19 +267,56 @@ class ServingCluster:
         eos_id: tp.Optional[int] = None,
         seed: int = 0,
     ) -> int:
-        """Admit onto the least-loaded replica (lowest index on ties —
-        deterministic, so a test trace routes identically every run);
-        returns the cluster-global request id."""
-        i = min(
-            range(len(self.engines)),
-            key=lambda j: (self._load(self.engines[j]), j),
+        """Admit onto the least-loaded HEALTHY replica (lowest index on
+        ties — deterministic, so a test trace routes identically every
+        run); returns the cluster-global request id. Raises
+        :class:`ClusterUnavailable` when every replica is dead, and
+        passes the engine's typed admission outcomes
+        (``AdmissionRejected``/``PoolOverloaded``) through to the
+        caller — a rejection burns no cluster rid.
+
+        A ``queue_full`` outcome SPILLS OVER: the routing metric (queue
+        + parked + active) is not the metric the bound is enforced on
+        (queue alone), so the least-loaded replica's full queue must
+        not shed a request another healthy replica has room for — the
+        remaining replicas are tried in load order and the overload
+        outcome raises only when every queue is full. (Per-engine
+        ``queue_full`` counters therefore count per-replica admission
+        attempts; the request is only actually shed/deferred when the
+        LAST replica refuses.) Permanent rejections are identical on
+        every replica and re-raise immediately."""
+        alive = self._alive()
+        if not alive:
+            raise ClusterUnavailable("every replica is dead")
+        order = sorted(
+            alive, key=lambda j: (self._load(self.engines[j]), j)
         )
-        local = self.engines[i].submit(
-            prompt, max_new_tokens, eos_id=eos_id, seed=seed
-        )
+        local = None
+        for n, i in enumerate(order):
+            try:
+                local = self.engines[i].submit(
+                    prompt, max_new_tokens, eos_id=eos_id, seed=seed
+                )
+                break
+            except (AdmissionRejected, PoolOverloaded) as exc:
+                if exc.reason != "queue_full" or n == len(order) - 1:
+                    raise
+        assert local is not None
         rid = self._next_rid
         self._next_rid += 1
         self._route[rid] = (i, local)
+        # submission record for COLD failover: a watchdog-tripped
+        # replica's step thread may still be running, so its engine can
+        # never be touched again — surviving requests are then re-served
+        # from scratch from this record (same tokens, by the determinism
+        # contract; only the already-emitted progress is recomputed).
+        # The ORIGINAL submit time rides along so a re-served request's
+        # TTFT still measures from first submission — hiding the outage
+        # the watchdog just detected would defeat the metric.
+        self._submitted[rid] = (
+            np.asarray(prompt, np.int32).reshape(-1).copy(),
+            max_new_tokens, eos_id, seed, self.engines[i].clock(),
+        )
         return rid
 
     def _harvest(self) -> None:
@@ -175,30 +325,247 @@ class ServingCluster:
             if req is not None:
                 self.finished[rid] = req
                 del self._route[rid]
+                self._submitted.pop(rid, None)
+
+    # -- failure handling ---------------------------------------------------
+
+    def _mark_dead(self, i: int, reason: str) -> None:
+        self.health[i] = "dead"
+        self.health_reason[i] = reason
+        if self.first_fault_time is None:
+            self.first_fault_time = time.monotonic()
+
+    def _failover(self, i: int, cold: bool = False) -> None:
+        """Fail dead replica ``i``'s backlog over to the survivors;
+        cluster rids keep pointing at the same logical requests — only
+        the (replica, local-rid) route changes. Two modes:
+
+        - WARM (default; the replica's step thread provably completed
+          by raising): the engine drains — in-flight slots convert
+          through the (bit-identical) eviction path, then queue and
+          parking lot — and the survivors resume with progress kept.
+        - COLD (``cold=True``; a watchdog trip — the step thread may
+          still be running inside the runtime): the engine is never
+          touched again (draining it would race live slot/page
+          mutations). Every request still routed to it re-serves FROM
+          SCRATCH off the cluster's submission record — the same stream
+          by the determinism contract, with only the un-harvested
+          progress recomputed, and the ORIGINAL submit time kept so
+          TTFT still shows the outage.
+
+        ``resubmit`` (not ``submit``) either way: already-accepted work
+        bypasses the bounded-queue admission control."""
+        self._harvest()  # dict reads are GIL-safe; scoop what finished
+        self.failovers += 1
+        drained = (
+            None if cold
+            else {r.rid: r for r in self.engines[i].drain_requests()}
+        )
+        mine = [g for g, (ri, _) in self._route.items() if ri == i]
+        n_moved = len(mine) if cold else len(drained)
+        self.requeued_requests += n_moved
+        alive = self._alive()
+        if not alive:
+            if self._route:
+                raise ClusterUnavailable(
+                    f"replica {i} died ({self.health_reason[i]}) with "
+                    f"{n_moved} requests to fail over and no survivors"
+                )
+            return
+        for grid in mine:
+            if cold:
+                prompt, n, eos_id, seed, t0 = self._submitted[grid]
+                j = self._least_loaded(alive)
+                req = self.engines[j].make_request(
+                    prompt, n, eos_id=eos_id, seed=seed
+                )
+                req.submit_time = t0
+            else:
+                req = drained.pop(self._route[grid][1], None)
+                if req is None:
+                    continue  # finished and harvested above
+                j = self._least_loaded(alive)
+            self._route[grid] = (j, self.engines[j].resubmit(req))
+        assert cold or not drained, (
+            f"drained requests {sorted(drained)} had no cluster route"
+        )
+
+    @staticmethod
+    def _classify(exc: BaseException) -> tp.Tuple[str, bool]:
+        """(death reason, cold failover?) for a terminal step fault. A
+        watchdog trip is the ONLY cold case — every other fault is a
+        raise out of the step thread, which proves it completed (a
+        scripted wedge's stall, in particular, has already ended)."""
+        if isinstance(exc, _WatchdogTrip):
+            return "wedged", True
+        if isinstance(exc, WedgedDispatch):
+            return "wedged", False
+        return "crashed", False
+
+    def _mark_terminal(self, i: int, exc: BaseException) -> bool:
+        """Classify a terminal fault, count it, mark the replica dead;
+        returns whether its failover must run COLD. Split from the
+        failover itself so step() can mark ALL of a round's faults dead
+        before any backlog moves."""
+        reason, cold = self._classify(exc)
+        if reason == "wedged":
+            self.watchdog_trips += 1
+        self._mark_dead(i, reason)
+        return cold
+
+    def _terminal_failure(self, i: int, exc: BaseException) -> None:
+        """The one dead/failover transition: classify, mark dead, fail
+        the backlog over."""
+        self._failover(i, cold=self._mark_terminal(i, exc))
+
+    @staticmethod
+    def _settle(f, timeout: tp.Optional[float]) -> bool:
+        """Wait for one replica-step future. Raises :class:`_WatchdogTrip`
+        ONLY when the wait expires with the step thread still running —
+        on Python 3.11+ ``concurrent.futures.TimeoutError`` IS the
+        builtin ``TimeoutError``, so one raised organically INSIDE
+        step() (thread completed) must NOT classify as a trip (a trip
+        triggers the cold, engine-abandoning failover; a completed
+        thread permits the warm drain)."""
+        try:
+            return bool(f.result(timeout=timeout))
+        except concurrent.futures.TimeoutError:
+            if not f.done():
+                raise _WatchdogTrip() from None
+            exc = f.exception()
+            if exc is None:
+                return bool(f.result())  # completed right at the deadline
+            raise exc
+
+    def _step_one(self, i: int, timeout: tp.Optional[float]) -> bool:
+        """One replica step, on the pool when there is one (so the wait
+        can be abandoned); raises the step's fault, if any."""
+        if self._pool is None:
+            return bool(self.engines[i].step())
+        return self._settle(self._pool.submit(self.engines[i].step), timeout)
+
+    def _recover(self, i: int) -> None:
+        """Retry replica ``i`` after a transient failure: capped
+        exponential backoff, suspect while retrying, healthy on success,
+        dead + failover when the retries exhaust (or the retry hits a
+        harder fault). The backoff sleeps run INLINE in the cluster's
+        scheduling thread — deliberate: the retry must re-enter the
+        replica's step() before the next scheduler round so scripted
+        transient sequences stay replayable (``backoff_cap_s`` bounds
+        the stall the other replicas see)."""
+        self.health[i] = "suspect"
+        self.health_reason[i] = "transient"
+        for attempt in range(self.max_retries):
+            time.sleep(
+                min(self.backoff_s * (2 ** attempt), self.backoff_cap_s)
+            )
+            self.retries += 1
+            try:
+                self._step_one(i, self.dispatch_timeout_s)
+            except TransientDispatchError:
+                continue
+            except self._STEP_FAULTS as exc:
+                self._terminal_failure(i, exc)
+                return
+            self.health[i] = "healthy"
+            self.health_reason[i] = None
+            return
+        self._mark_dead(i, "transient_exhausted")
+        self._failover(i)
+
+    # every fault class a replica step can surface; anything else is a
+    # real bug and propagates. concurrent.futures.TimeoutError is listed
+    # separately for Python < 3.11, where it is not the builtin
+    # TimeoutError (organic timeouts classify as crashes either way —
+    # _settle converts genuine wait-expiries to _WatchdogTrip first)
+    _STEP_FAULTS = (
+        TransientDispatchError,
+        WedgedDispatch,
+        ReplicaCrash,
+        TimeoutError,
+        concurrent.futures.TimeoutError,
+        _WatchdogTrip,
+    )
 
     def step(self) -> bool:
-        """One scheduler window on EVERY replica, dispatched
+        """One scheduler window on EVERY live replica, dispatched
         CONCURRENTLY (one thread per engine): each engine's step blocks
         on its own device->host read, so the threads overlap the
         replicas' windows on their disjoint devices — aggregate
         throughput scales with replicas instead of time-multiplexing
-        them. Returns True while any replica has (or had) work."""
+        them. Replica failures route through the health state machine
+        (watchdog / retry / failover) instead of propagating — in two
+        phases: every replica's future SETTLES (completes, raises, or
+        times out) before any failure is processed, so failover
+        re-queueing never mutates an engine whose own step is still in
+        flight (each engine stays single-threaded, and the chaos replay
+        contract stays exact). Returns True while any replica has (or
+        had) work; raises :class:`ClusterUnavailable` if every replica
+        is dead with requests still pending."""
+        alive = self._alive()
+        if not alive:
+            if self._route:
+                raise ClusterUnavailable(
+                    "every replica is dead with requests pending"
+                )
+            return False
+        progressed = False
+        faults: tp.List[tp.Tuple[int, BaseException]] = []
         if self._pool is None:
-            progressed = self.engines[0].step()
+            try:
+                progressed = bool(self.engines[alive[0]].step())
+            except self._STEP_FAULTS as exc:
+                faults.append((alive[0], exc))
         else:
-            progressed = any(
-                list(self._pool.map(lambda e: e.step(), self.engines))
+            futs = [
+                (i, self._pool.submit(self.engines[i].step)) for i in alive
+            ]
+            # ONE deadline for the whole round, from dispatch: the
+            # futures run concurrently, so waiting them out in sequence
+            # against per-wait timeouts would detect a wedge on the
+            # last replica up to N*timeout late
+            deadline = (
+                None if self.dispatch_timeout_s is None
+                else time.monotonic() + self.dispatch_timeout_s
             )
+            for i, f in futs:
+                try:
+                    r = self._settle(
+                        f,
+                        None if deadline is None
+                        else max(0.0, deadline - time.monotonic()),
+                    )
+                    progressed = r or progressed
+                except self._STEP_FAULTS as exc:
+                    faults.append((i, exc))
+        # a fault is progress: its backlog moved or retried, and a
+        # drained cluster never re-steps
+        progressed = progressed or bool(faults)
+        # mark EVERY terminal fault dead before running ANY failover:
+        # two replicas faulting in the same round must not fail over
+        # onto each other (a crash's warm drain re-queued onto a
+        # watchdog-tripped engine whose step thread is still running
+        # would violate the never-mutate-mid-step contract)
+        terminal = [
+            (i, self._mark_terminal(i, exc))
+            for i, exc in faults
+            if not isinstance(exc, TransientDispatchError)
+        ]
+        # retries next (the replica heals or joins the dead set), then
+        # the failovers — every target is settled and provably alive
+        for i, exc in faults:
+            if isinstance(exc, TransientDispatchError):
+                self._recover(i)
+        for i, cold in terminal:
+            self._failover(i, cold=cold)
         self._harvest()
         return progressed
 
     def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
-        """Drive :meth:`step` until every replica drains; returns the
-        finished requests by cluster-global id."""
+        """Drive :meth:`step` until every live replica drains; returns
+        the finished requests by cluster-global id."""
         for _ in range(max_windows):
-            if not any(
-                e.queue or e._active_slots() for e in self.engines
-            ):
+            if not self.has_work:
                 break
             self.step()
         else:
@@ -210,7 +577,8 @@ class ServingCluster:
 
     def stats(self) -> tp.Dict[str, tp.Any]:
         """Summed engine counters (ServingEngine.stats key layout) plus
-        ``dp_replicas`` and the ``per_replica`` breakdown."""
+        ``dp_replicas``, the ``per_replica`` breakdown, and the
+        cluster-level failover counters."""
         per = [e.stats() for e in self.engines]
         agg: tp.Dict[str, tp.Any] = {}
         for k in per[0]:
@@ -219,8 +587,21 @@ class ServingCluster:
                 agg[k] = round(sum(s[k] for s in per) / len(per), 4)
             elif k == "tp":
                 agg[k] = per[0][k]
+            elif isinstance(per[0][k], dict):
+                merged: tp.Dict[str, int] = {}
+                for s in per:
+                    for kk, vv in s[k].items():
+                        merged[kk] = merged.get(kk, 0) + vv
+                agg[k] = merged
             else:
                 agg[k] = sum(s[k] for s in per)
         agg["dp_replicas"] = len(per)
+        agg["watchdog_trips"] = self.watchdog_trips
+        agg["retries"] = self.retries
+        agg["failovers"] = self.failovers
+        agg["requeued_requests"] = self.requeued_requests
+        agg["dead_replicas"] = self.health.count("dead")
+        agg["replica_health"] = list(self.health)
+        agg["replica_health_reason"] = list(self.health_reason)
         agg["per_replica"] = per
         return agg

@@ -80,6 +80,7 @@ from midgpt_tpu.models.gpt import (
     prefill_chunk_paged,
     verify_tokens_paged,
 )
+from midgpt_tpu.serving.faults import AdmissionRejected, PoolOverloaded
 from midgpt_tpu.serving.speculate import NgramProposer, Proposer
 from midgpt_tpu.serving.paged import (
     PageAllocator,
@@ -650,6 +651,13 @@ class Request:
     spec_rate: float = 1.0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # overload bookkeeping: tokens held at the LAST admission (progress
+    # detector) and the count of consecutive evictions with zero progress
+    # since then — the eviction-livelock guard parks the request at
+    # ``park_threshold`` (two requests thrashing each other's pages
+    # would otherwise re-prefill in a loop instead of one waiting)
+    admit_tokens: int = 0
+    thrash: int = 0
 
     @property
     def done(self) -> bool:
@@ -698,6 +706,18 @@ class ServingEngine:
     Capacity contract: a request must fit its context in ``block_size``
     (prompts are cropped to ``block_size - max_new_tokens`` like the
     reference sampler crops to the window, sample.py:74).
+
+    Overload and faults degrade, they don't crash (serving.faults):
+    unservable submissions raise typed, counted ``AdmissionRejected``;
+    a full bounded queue (``max_queue``) sheds or defers per
+    ``overload_policy``; pool pressure a lone request can't evict its
+    way out of PARKS the request (progress kept) instead of raising
+    ``MemoryError``; and the eviction-livelock guard parks a request
+    evicted ``park_threshold`` times without progress. A scripted
+    ``fault_hook`` (``FaultPlan.hook``) injects deterministic chaos at
+    step boundaries; ``drain_requests``/``resubmit`` are the cluster's
+    failover seam, and every degraded path preserves the bit-identical
+    stream contract above.
     """
 
     def __init__(
@@ -724,8 +744,32 @@ class ServingEngine:
         paged_kernel: str = "auto",
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
+        max_queue: tp.Optional[int] = None,
+        overload_policy: str = "defer",
+        park_threshold: int = 2,
+        fault_hook: tp.Optional[tp.Callable[["ServingEngine"], None]] = None,
     ):
         assert slots >= 1 and window >= 1 and page_size >= 1
+        # overload degradation knobs: max_queue bounds the wait queue
+        # (None = unbounded, the library default); a submit hitting the
+        # bound is SHED (AdmissionRejected, the request is dropped for
+        # good) or DEFERRED (PoolOverloaded, the caller's backpressure
+        # signal to retry later). park_threshold is the eviction-livelock
+        # guard: a request evicted that many times in a row without
+        # emitting a token parks until pages free up, instead of
+        # re-prefilling in a thrash loop.
+        assert overload_policy in ("defer", "shed"), overload_policy
+        assert max_queue is None or max_queue >= 1, max_queue
+        assert park_threshold >= 1, park_threshold
+        self.max_queue = max_queue
+        self.overload_policy = overload_policy
+        self.park_threshold = park_threshold
+        # deterministic fault injection (serving.faults): called at the
+        # top of every step() with this engine, AFTER fault_step
+        # incremented and BEFORE any dispatch — zero-cost when absent
+        # (one is-None check per scheduler window)
+        self._fault_hook = fault_hook
+        self.fault_step = 0
         # int8 quantized KV pool (serving.paged / quant.py's KV grid):
         # page payloads store int8 with one f32 po2 scale per
         # (page, KV-head) plane, halving the K+V HBM stream every decode
@@ -923,6 +967,10 @@ class ServingEngine:
         self._prefill_rr = 0
 
         self.queue: tp.Deque[Request] = collections.deque()
+        # overload parking lot: requests evicted by the livelock guard or
+        # by single-slot pool exhaustion wait here (progress kept) until
+        # a finish / quarantine release / idle engine un-parks them
+        self.parked: tp.List[Request] = []
         self.finished: tp.Dict[int, Request] = {}
         self._next_rid = 0
 
@@ -972,8 +1020,22 @@ class ServingEngine:
         self.verify_dispatches = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # fault-tolerance / overload counters (stats())
+        self.admission_rejected = 0
+        self.reject_reasons: tp.Dict[str, int] = {}
+        self.shed_requests = 0
+        self.deferred_submits = 0
+        self.livelock_parks = 0
+        self.overload_parks = 0
+        self.faults_injected = 0
 
     # -- submission ---------------------------------------------------------
+
+    def _reject(self, reason: str, message: str) -> tp.NoReturn:
+        """Typed, counted admission rejection (machine-readable reason)."""
+        self.admission_rejected += 1
+        self.reject_reasons[reason] = self.reject_reasons.get(reason, 0) + 1
+        raise AdmissionRejected(reason, message)
 
     def submit(
         self,
@@ -984,39 +1046,119 @@ class ServingEngine:
         seed: int = 0,
     ) -> int:
         """Queue a request; returns its id. Prompts are cropped to the last
-        ``block_size - max_new_tokens`` tokens so the whole context fits."""
-        assert max_new_tokens >= 1, max_new_tokens
-        assert max_new_tokens < self.block, (
-            f"max_new_tokens {max_new_tokens} must leave room for at least "
-            f"one prompt token in block_size {self.block}"
-        )
+        ``block_size - max_new_tokens`` tokens so the whole context fits.
+
+        Unservable requests raise :class:`AdmissionRejected` (permanent:
+        a bad budget, an empty prompt, or a lifetime page demand larger
+        than the whole pool — nothing the engine does later can serve
+        it); a full bounded wait queue raises AdmissionRejected under
+        ``overload_policy="shed"`` or :class:`PoolOverloaded` under
+        ``"defer"`` (transient — the caller's cue to back off and
+        resubmit). Both are counted in :meth:`stats` — overload must
+        show up in telemetry, not as a crash."""
+        if max_new_tokens < 1:
+            self._reject("bad_budget", f"max_new_tokens {max_new_tokens} < 1")
+        if max_new_tokens >= self.block:
+            self._reject(
+                "budget_exceeds_block",
+                f"max_new_tokens {max_new_tokens} must leave room for at "
+                f"least one prompt token in block_size {self.block}",
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        assert prompt.size >= 1, "empty prompt"
+        if prompt.size < 1:
+            self._reject("empty_prompt", "prompt has no tokens")
         keep = self.block - max_new_tokens
         if prompt.size > keep:
             prompt = prompt[-keep:]
         lifetime = pages_needed(
             int(prompt.size) + max_new_tokens, self.page_size
         )
-        assert lifetime <= self.alloc.num_pages, (
-            f"request needs {lifetime} pages over its lifetime but the pool "
-            f"holds {self.alloc.num_pages}; raise num_pages"
+        if lifetime > self.alloc.num_pages:
+            self._reject(
+                "lifetime_exceeds_pool",
+                f"request needs {lifetime} pages over its lifetime but the "
+                f"pool holds {self.alloc.num_pages}; raise num_pages",
+            )
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            if self.overload_policy == "shed":
+                self.shed_requests += 1
+                self._reject(
+                    "queue_full",
+                    f"wait queue at max_queue={self.max_queue}; shed",
+                )
+            self.deferred_submits += 1
+            raise PoolOverloaded(
+                "queue_full",
+                f"wait queue at max_queue={self.max_queue}; retry later",
+            )
+        return self.resubmit(
+            self.make_request(prompt, max_new_tokens, eos_id=eos_id,
+                              seed=seed)
         )
+
+    def make_request(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        *,
+        eos_id: tp.Optional[int] = None,
+        seed: int = 0,
+    ) -> Request:
+        """Build a :class:`Request` exactly as :meth:`submit` would —
+        crop included — WITHOUT admission control or queueing. The
+        cluster's cold-failover path uses this + :meth:`resubmit` to
+        re-serve an already-accepted request from scratch."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        keep = self.block - max_new_tokens
+        if prompt.size > keep:
+            prompt = prompt[-keep:]
+        return Request(
+            rid=-1,  # assigned at resubmit
+            prompt=prompt,
+            prompt0=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_id=-1 if eos_id is None else int(eos_id),
+            seed=seed,
+            submit_time=self.clock(),
+            spec_k=self.speculate,
+        )
+
+    def resubmit(self, req: Request) -> int:
+        """Failover re-admission (serving.cluster): enqueue an already-
+        accepted :class:`Request` — typically drained off a dead replica
+        — under a fresh engine-local id, progress preserved. Bypasses
+        the bounded-queue admission control on purpose: this is work the
+        cluster already accepted, not new load."""
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(
-            Request(
-                rid=rid,
-                prompt=prompt,
-                prompt0=prompt,
-                max_new_tokens=max_new_tokens,
-                eos_id=-1 if eos_id is None else int(eos_id),
-                seed=seed,
-                submit_time=self.clock(),
-                spec_k=self.speculate,
-            )
-        )
+        req.rid = rid
+        self.queue.append(req)
         return rid
+
+    def drain_requests(self) -> tp.List[Request]:
+        """Hand every live request off this engine (failover): in-flight
+        slots are converted exactly like an eviction (context rebuilt
+        from the original prompt + all emitted tokens, budget intact),
+        then the wait queue and the parking lot follow. The engine is
+        left empty; its pages return to the allocator. Because requests
+        carry only really-emitted tokens — faults fire at step
+        boundaries, before any dispatch mutates state — a survivor
+        resuming a drained request continues the stream bit-identically
+        (the eviction/re-admission contract, plus placement invariance
+        across replicas)."""
+        out: tp.List[Request] = []
+        for s in self._active_slots():
+            req = self.slot_req[s]
+            req.prompt = np.concatenate(
+                [req.prompt0, np.asarray(req.tokens, np.int32)]
+            )
+            self._release_slot(s)
+            out.append(req)
+        out.extend(self.queue)
+        self.queue.clear()
+        out.extend(self.parked)
+        self.parked.clear()
+        return out
 
     # -- internals ----------------------------------------------------------
 
@@ -1129,6 +1271,7 @@ class ServingEngine:
             self.prompt_tokens_total += p
             self.prompt_tokens_cached += matched
             req.cached_tokens += matched
+            req.admit_tokens = len(req.tokens)  # livelock-guard baseline
             admitted += 1
 
     # -- chunked prefill ----------------------------------------------------
@@ -1252,15 +1395,27 @@ class ServingEngine:
         self.slot_registered[s] = 0
         self.slot_node[s] = PrefixIndex._ROOT
 
-    def _evict(self, s: int) -> None:
+    def _evict(self, s: int, park: bool = False) -> None:
         """Preempt slot ``s``: keep its progress (prompt grows by the
         generated tokens, budget shrinks to the remainder) and re-queue it
         at the FRONT so it resumes as soon as pages free up. Its pages
         retire to the cold prefix cache, so re-admission typically
         re-prefills via cache hits — same tokens, a fraction of the
-        FLOPs, and still bit-identical."""
+        FLOPs, and still bit-identical.
+
+        Livelock guard: a request evicted ``park_threshold`` times in a
+        row WITHOUT emitting a token since its admission is thrashing —
+        two requests repeatedly trading the same pages would re-prefill
+        each other forever — so it PARKS (``self.parked``) until a
+        finish, a quarantine release, or an idle engine un-parks it,
+        instead of spinning through admission again. ``park=True``
+        (single-slot pool exhaustion) parks unconditionally. Parking
+        rides the same progress-preserving path as eviction, so parked
+        streams resume bit-identically too."""
         req = self.slot_req[s]
         assert req is not None
+        progressed = len(req.tokens) > req.admit_tokens
+        req.thrash = 0 if progressed else req.thrash + 1
         # rebuild from the ORIGINAL prompt (a second eviction appending to
         # an already-grown prompt would duplicate the first eviction's
         # tokens — caught in code review). prompt0 <= block - max_new, so
@@ -1271,8 +1426,24 @@ class ServingEngine:
         )
         req.evictions += 1
         self._release_slot(s)
-        self.queue.appendleft(req)
         self.evictions += 1
+        if park:
+            self.overload_parks += 1
+            self.parked.append(req)
+        elif req.thrash >= self.park_threshold:
+            self.livelock_parks += 1
+            self.parked.append(req)
+        else:
+            self.queue.appendleft(req)
+
+    def _unpark(self) -> None:
+        """Move every parked request back onto the wait queue (FIFO).
+        Called when pages may have come back: a request finished, a
+        fault-injected quarantine lifted, or the engine went otherwise
+        idle (nothing else will ever free pages, so parked work must
+        retry)."""
+        while self.parked:
+            self.queue.append(self.parked.pop(0))
 
     def _ensure_growth(self) -> None:
         """Before the window, every decoding slot needs pages for up to K
@@ -1291,14 +1462,23 @@ class ServingEngine:
             need = min(
                 pages_needed(tokens, self.page_size), self.pmax
             ) - len(self.slot_pages[s])
+            parked_self = False
             while need > 0 and not self._try_reserve(need):
                 others = [v for v in self._active_slots() if v != s]
                 if not others:
-                    raise MemoryError(
-                        "page pool too small for a single request's window"
-                    )
+                    # even the lone request cannot grow (fault-injected
+                    # quarantine, or a pool transiently starved of cold
+                    # pages): PARK it with progress kept instead of the
+                    # old hard MemoryError — it resumes when pages come
+                    # back, and overload shows up as a counter, not a
+                    # crash
+                    self._evict(s, park=True)
+                    parked_self = True
+                    break
                 # least progress loses: cheapest re-prefill on re-admission
                 self._evict(min(others, key=lambda v: len(self.slot_req[v].tokens)))
+            if parked_self:
+                continue
             if need > 0:
                 pages = self.alloc.alloc(need)
                 start = len(self.slot_pages[s])
@@ -1389,6 +1569,7 @@ class ServingEngine:
         self.pooled_len = np.array(new_len, np.int32)
         self.emitted = np.array(emitted_d, np.int32)
         now = self.clock()
+        finished_any = False
         for s in decoding:
             req = self.slot_req[s]
             new = [
@@ -1407,15 +1588,33 @@ class ServingEngine:
                 req.finish_time = now
                 self.finished[req.rid] = req
                 self._release_slot(s)
+                finished_any = True
+        if finished_any and self.parked:
+            self._unpark()  # freed pages: parked requests get another shot
+
+    @property
+    def has_work(self) -> bool:
+        """Queued, parked, or in-flight requests remain."""
+        return bool(self.queue or self.parked or self._active_slots())
 
     def step(self) -> bool:
-        """One scheduler window. Returns True while there is (or was) work."""
+        """One scheduler window. Returns True while there is (or was) work.
+
+        May raise a scripted :mod:`~midgpt_tpu.serving.faults` fault when
+        a ``fault_hook`` is installed — always BEFORE any dispatch, so
+        the engine's request state stays consistent and drainable."""
+        self.fault_step += 1
+        if self._fault_hook is not None:
+            self._fault_hook(self)
+        if self.parked and not self.queue and not self._active_slots():
+            # nothing else can free pages — parked work must retry now
+            self._unpark()
         self._admit()
         self._run_prefills()
         decoding = self._decoding_slots()
         if not decoding:
             # progress was prefill-only (or nothing runnable yet)
-            return bool(self.queue) or bool(self._active_slots())
+            return self.has_work
         self._ensure_growth()
         decoding = self._decoding_slots()  # eviction may have changed it
         if not decoding:
@@ -1453,6 +1652,7 @@ class ServingEngine:
         self.pooled_len = np.array(new_len, np.int32)
         self.emitted = np.array(emitted_d, np.int32)
         now = self.clock()
+        finished_any = False
         for s in decoding:
             req = self.slot_req[s]
             new = [int(t) for r in range(self.window)
@@ -1469,6 +1669,9 @@ class ServingEngine:
                 req.finish_time = now
                 self.finished[req.rid] = req
                 self._release_slot(s)
+                finished_any = True
+        if finished_any and self.parked:
+            self._unpark()  # freed pages: parked requests get another shot
         return True
 
     def warm_prefill(self, max_tokens: int) -> tp.List[int]:
@@ -1533,7 +1736,7 @@ class ServingEngine:
         """Drive :meth:`step` until queue and slots drain; returns the
         finished requests by id."""
         for _ in range(max_windows):
-            if not self.queue and not self._active_slots():
+            if not self.has_work:
                 break
             self.step()
         else:
@@ -1572,4 +1775,13 @@ class ServingEngine:
             "spec_acceptance_rate": round(
                 self.spec_accepted / max(1, self.spec_drafted), 4
             ),
+            # fault tolerance / overload degradation (serving.faults)
+            "admission_rejected": self.admission_rejected,
+            "reject_reasons": dict(self.reject_reasons),
+            "shed_requests": self.shed_requests,
+            "deferred_submits": self.deferred_submits,
+            "livelock_parks": self.livelock_parks,
+            "overload_parks": self.overload_parks,
+            "parked_requests": len(self.parked),
+            "faults_injected": self.faults_injected,
         }

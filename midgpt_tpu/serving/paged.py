@@ -157,11 +157,18 @@ class PageAllocator:
       page whose KV is kept for future hits until page pressure reclaims
       it (:meth:`reclaim`). Never written while cached.
 
-    Invariants (tested): ``free + held + cached == num_pages``; a
-    refcount is never negative (decref of a free/cached page raises);
-    double-free and foreign-free raise. Allocation is LIFO so a request
-    that frees and re-allocates under light load reuses hot pages
-    (better HBM locality than FIFO cycling through the whole pool)."""
+    A fourth state exists only under fault injection
+    (serving.faults, the ``exhaust`` event): **quarantined** — taken off
+    the free list to simulate allocator exhaustion, returned verbatim by
+    :meth:`release_quarantined`. Normal operation never quarantines.
+
+    Invariants (tested): ``free + held + cached + quarantined ==
+    num_pages`` (quarantined is 0 outside chaos runs, so the classic
+    three-way identity holds there); a refcount is never negative
+    (decref of a free/cached page raises); double-free and foreign-free
+    raise. Allocation is LIFO so a request that frees and re-allocates
+    under light load reuses hot pages (better HBM locality than FIFO
+    cycling through the whole pool)."""
 
     def __init__(self, num_pages: int):
         assert num_pages >= 1, num_pages
@@ -169,6 +176,7 @@ class PageAllocator:
         self._free: tp.List[int] = list(range(num_pages - 1, -1, -1))
         self._ref: tp.Dict[int, int] = {}
         self._cached: tp.Set[int] = set()
+        self._quarantined: tp.List[int] = []
 
     @property
     def free_pages(self) -> int:
@@ -181,6 +189,31 @@ class PageAllocator:
     @property
     def cached_pages(self) -> int:
         return len(self._cached)
+
+    @property
+    def quarantined_pages(self) -> int:
+        return len(self._quarantined)
+
+    def quarantine(self, n: int = -1) -> int:
+        """Fault injection (serving.faults ``exhaust``): pull up to ``n``
+        FREE pages (-1 = all of them) out of circulation — held and
+        cached pages are untouched, so live requests keep their pages
+        and the prefix cache keeps serving hits; only new allocation
+        feels the pressure. Returns the count actually quarantined."""
+        if n < 0:
+            n = len(self._free)
+        n = min(n, len(self._free))
+        for _ in range(n):
+            self._quarantined.append(self._free.pop())
+        return n
+
+    def release_quarantined(self) -> int:
+        """Undo :meth:`quarantine`: every quarantined page returns to
+        the free list. Returns the count released."""
+        n = len(self._quarantined)
+        self._free.extend(self._quarantined)
+        self._quarantined.clear()
+        return n
 
     def refcount(self, p: int) -> int:
         return self._ref.get(p, 0)
@@ -247,13 +280,19 @@ class PageAllocator:
         mutation sequence)."""
         assert (
             len(self._free) + len(self._ref) + len(self._cached)
+            + len(self._quarantined)
             == self.num_pages
         )
         assert len(set(self._free)) == len(self._free), "free-list dup"
         held = set(self._ref)
+        quarantined = set(self._quarantined)
+        assert len(quarantined) == len(self._quarantined), "quarantine dup"
         assert not (set(self._free) & held), "page both free and held"
         assert not (set(self._free) & self._cached), "page both free/cached"
         assert not (held & self._cached), "page both held and cached"
+        assert not (
+            quarantined & (set(self._free) | held | self._cached)
+        ), "quarantined page also free/held/cached"
         assert all(n >= 1 for n in self._ref.values()), "refcount < 1"
 
 

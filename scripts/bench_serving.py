@@ -47,6 +47,18 @@ what the prefix cache exists for; run it with --prefix_cache on/off to
 ladder the win. --prefill_chunk C prefills Sarathi-style in C-token
 chunks interleaved with decode (bounds TTFT under long prompts).
 
+Chaos runs (--fault_plan "2:transient@0;4:crash@0", serving.faults spec
+grammar) drive the trace through a ServingCluster with scripted,
+deterministic fault injection: replica crashes/wedges/transient errors
+recover via health-tracked failover (bit-identical streams — the chaos
+suite's landing gate), and the record gains "status" plus recovery and
+goodput-under-faults metrics (serve_goodput_tok_s counts only FINISHED
+requests' tokens; serve_recovery_s is first-replica-death -> drain).
+A whole-trace watchdog (--deadline_s) turns a wedged relay into a
+structured {"status": "watchdog"} row instead of an opaque hang — so
+BENCH_r*.json trajectories distinguish hardware wedges from regressions
+(the r4/r5 lesson).
+
 The decode-dispatch arithmetic is the point (PERF.md): the fixed-batch
 sampler launches one XLA dispatch per generated token; the engine fuses K
 whole-model steps per launch, so the dispatch count is ~tokens/(K*slots)
@@ -136,6 +148,27 @@ def main() -> None:
                     "(midgpt_tpu.serving.ServingCluster); each replica "
                     "owns tp devices, its own page pool and prefix "
                     "cache — throughput scales, nothing is shared")
+    ap.add_argument("--fault_plan", default=None,
+                    help="scripted chaos (serving.faults spec grammar, "
+                    "e.g. '2:transient@0;4:crash@0'): deterministic "
+                    "fault injection keyed to scheduler steps, driven "
+                    "through a ServingCluster so crash/wedge/transient "
+                    "recover via failover — the record gains recovery + "
+                    "goodput-under-faults metrics")
+    ap.add_argument("--dispatch_timeout_s", type=float, default=None,
+                    help="cluster wall-clock dispatch watchdog (the "
+                    "wedged-relay case): a replica step exceeding this "
+                    "is abandoned and its backlog fails over")
+    ap.add_argument("--max_retries", type=int, default=3,
+                    help="capped-exponential-backoff retries for "
+                    "transient dispatch errors before failover")
+    ap.add_argument("--backoff_s", type=float, default=0.05)
+    ap.add_argument("--deadline_s", type=float, default=900.0,
+                    help="whole-trace watchdog: if the trace has not "
+                    "drained by then, emit a structured "
+                    '{"status": "watchdog"} row and exit — BENCH_r*.json '
+                    "then records a hardware wedge as a wedge, not an "
+                    "opaque error (the r4/r5 lesson)")
     ap.add_argument("--out", default=None,
                     help="output JSON path (default "
                     "artifacts/bench_serving.json; the r6 queue's K-ladder "
@@ -145,6 +178,53 @@ def main() -> None:
     add_platform_arg(ap)
     args = ap.parse_args()
     apply_platform(args.platform)
+
+    # whole-RUN watchdog, armed BEFORE backend init: the r4/r5 wedges
+    # happened in the compile/init phase, so a deadline that only covers
+    # the timed trace would still hang opaquely there. A wedge at any
+    # phase must yield a STRUCTURED row ({"status": "watchdog", "phase":
+    # ...}), not an opaque hang/error — BENCH trajectories then separate
+    # hardware wedges from regressions. Daemon thread + os._exit like
+    # bench.py's watchdogs.
+    import threading
+
+    shape = (
+        f"{args.preset} S={args.slots} K={args.window} "
+        f"page={args.page_size} cache={args.prefix_cache} "
+        f"chunk={args.prefill_chunk or 'mono'} "
+        f"sys={args.sys_prompt_len} "
+        f"spec={args.spec_len if args.spec == 'on' else 'off'}"
+        f"{' rep' if args.repetitive else ''}"
+        f" quant={args.quant} kv_quant={args.kv_quant}"
+        f" kernel={args.paged_kernel}"
+        f" tp={args.tp} dp={args.dp_replicas}"
+        f"{' faults=' + args.fault_plan if args.fault_plan else ''}"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")
+    run_done = threading.Event()
+    phase = {"name": "init"}  # init -> warmup -> trace
+
+    def _run_watchdog():
+        if run_done.wait(args.deadline_s) or run_done.is_set():
+            return
+        row = {
+            "status": "watchdog",
+            "phase": phase["name"],
+            "serve_shape": shape,
+            "serve_deadline_s": args.deadline_s,
+            "error": (
+                f"serving bench exceeded {args.deadline_s:.0f}s in the "
+                f"{phase['name']} phase (wedged TPU relay?)"
+            ),
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+        print(json.dumps(row), flush=True)
+        os._exit(4)
+
+    threading.Thread(target=_run_watchdog, daemon=True).start()
 
     import jax
     import jax.numpy as jnp
@@ -215,8 +295,14 @@ def main() -> None:
             for i, p in enumerate(prompts)
         ]
 
-    from midgpt_tpu.serving import ServingCluster, serving_meshes
+    from midgpt_tpu.serving import (
+        ClusterUnavailable,
+        FaultPlan,
+        ServingCluster,
+        serving_meshes,
+    )
 
+    plan = FaultPlan.parse(args.fault_plan) if args.fault_plan else None
     engine_kw = dict(
         slots=args.slots,
         page_size=args.page_size,
@@ -230,12 +316,31 @@ def main() -> None:
         paged_kernel=args.paged_kernel,
     )
     meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
-    if args.dp_replicas > 1:
-        eng = ServingCluster(model, meshes=meshes, **engine_kw)
+    # fault injection and the dispatch watchdog live in the cluster's
+    # health/failover layer, so chaos runs always drive a cluster (a
+    # 1-replica cluster is the degenerate case: faults still degrade
+    # into typed outcomes instead of crashing the bench)
+    use_cluster = (
+        args.dp_replicas > 1
+        or plan is not None
+        or args.dispatch_timeout_s is not None
+    )
+    if use_cluster:
+        eng = ServingCluster(
+            model, meshes=meshes, fault_plan=plan,
+            dispatch_timeout_s=args.dispatch_timeout_s,
+            max_retries=args.max_retries, backoff_s=args.backoff_s,
+            **engine_kw,
+        )
         engines = eng.engines
     else:
         eng = ServingEngine(model, mesh=meshes[0], **engine_kw)
         engines = [eng]
+    # the engine resolved paged_kernel="auto" to a concrete backend;
+    # the watchdog closure reads the rebound name
+    shape = shape.replace(
+        f"kernel={args.paged_kernel}", f"kernel={engines[0].paged_kernel}"
+    )
 
     # warmup: compile the decode window + EVERY prefill-chunk bucket the
     # trace can dispatch, on EVERY replica. Full-prompt buckets are not
@@ -245,7 +350,9 @@ def main() -> None:
     # inside the timed region — corrupting exactly the comparison they
     # exist for. (DP replicas share program wrappers only when pinned to
     # identical devices — they are not — so each warms its own.)
+    phase["name"] = "warmup"
     for e in engines:
+        e._fault_hook = None  # chaos must not fire inside warmup
         e.submit(prompts[0], int(nnews[0]))
         e.run()
         e.warm_prefill(max(p.size for p in prompts))
@@ -258,28 +365,46 @@ def main() -> None:
                      "cold_reclaims", "verify_dispatches", "spec_drafted",
                      "spec_accepted"):
             setattr(e, attr, 0)
-    if args.dp_replicas > 1:
+    if use_cluster:
         eng.finished.clear()
         eng._route.clear()
+    if plan is not None:
+        # re-arm FRESH hooks with step counters at zero: the scripted
+        # plan is keyed to the measured trace's scheduler steps, not the
+        # warmup's
+        for i, e in enumerate(engines):
+            e._fault_hook = plan.hook(i)
+            e.fault_step = 0
+            e.faults_injected = 0
 
+    phase["name"] = "trace"
+    status, status_error = "ok", None
     t0 = time.monotonic()
     submitted = 0
-    while submitted < args.requests or any(
-        e.queue or e._active_slots() for e in engines
-    ):
-        now = time.monotonic() - t0
-        while submitted < args.requests and arrivals[submitted] <= now:
-            eng.submit(
-                prompts[submitted], int(nnews[submitted]),
-                seed=submitted,
-            )
-            submitted += 1
-        progressed = eng.step()
-        if not progressed and submitted < args.requests:
-            time.sleep(
-                max(0.0, arrivals[submitted] - (time.monotonic() - t0))
-            )
+    try:
+        while submitted < args.requests or eng.has_work:
+            now = time.monotonic() - t0
+            while submitted < args.requests and arrivals[submitted] <= now:
+                eng.submit(
+                    prompts[submitted], int(nnews[submitted]),
+                    seed=submitted,
+                )
+                submitted += 1
+            progressed = eng.step()
+            if not progressed and submitted < args.requests:
+                time.sleep(
+                    max(0.0, arrivals[submitted] - (time.monotonic() - t0))
+                )
+    except ClusterUnavailable as exc:
+        # every replica died with work pending: still a structured row —
+        # the goodput metrics below cover what DID finish
+        status, status_error = "unavailable", str(exc)
     wall = time.monotonic() - t0
+    t_end = time.monotonic()
+    # the watchdog stays armed: the report phase still talks to the
+    # device (memory_stats, the tp>1 comms summary re-compiles the
+    # window), so a post-trace wedge must still yield a structured row
+    phase["name"] = "report"
 
     # device peak HBM AFTER the trace: the halved weight stream is a
     # residency win too (int8 params + the same KV pool). CPU backends
@@ -347,22 +472,27 @@ def main() -> None:
     ttfts = sorted(
         (r.first_token_time - r.submit_time) * 1e3
         for r in eng.finished.values()
+        if r.first_token_time is not None
     )
-    pct = lambda q: ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))]  # noqa: E731
+    pct = (  # noqa: E731
+        (lambda q: round(ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))], 1))
+        if ttfts else (lambda q: None)
+    )
     st = eng.stats()
+    # goodput under faults: each finished request's tokens count exactly
+    # once, however many times faults made the engines recompute them.
+    # serve_tok_s (tokens_generated) stays the raw engine WORK rate — a
+    # warm failover carries emitted tokens to the survivor (no recount),
+    # but a COLD one re-serves from scratch, so the dead replica's
+    # progress is generated twice; the gap between the two rates is the
+    # throughput the faults burned.
+    good_tokens = sum(len(r.tokens) for r in eng.finished.values())
+    # recovery: wall-clock from the first replica death to trace drain
+    first_fault = getattr(eng, "first_fault_time", None)
     record = {
         "device": jax.devices()[0].device_kind,
-        "serve_shape": (
-            f"{args.preset} S={args.slots} K={args.window} "
-            f"page={args.page_size} cache={args.prefix_cache} "
-            f"chunk={args.prefill_chunk or 'mono'} "
-            f"sys={args.sys_prompt_len} "
-            f"spec={args.spec_len if args.spec == 'on' else 'off'}"
-            f"{' rep' if args.repetitive else ''}"
-            f" quant={args.quant} kv_quant={args.kv_quant}"
-            f" kernel={engines[0].paged_kernel}"
-            f" tp={args.tp} dp={args.dp_replicas}"
-        ),
+        "status": status,
+        "serve_shape": shape,
         "serve_tp": args.tp,
         "serve_dp_replicas": args.dp_replicas,
         "serve_comms_bytes_per_dispatch": comms_bytes,
@@ -384,8 +514,8 @@ def main() -> None:
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
         "serve_wall_s": round(wall, 3),
         "serve_tok_s": round(st["tokens_generated"] / wall, 1),
-        "serve_ttft_p50_ms": round(pct(0.50), 1),
-        "serve_ttft_p99_ms": round(pct(0.99), 1),
+        "serve_ttft_p50_ms": pct(0.50),
+        "serve_ttft_p99_ms": pct(0.99),
         "serve_slot_occupancy": st["slot_occupancy"],
         "serve_decode_dispatches": st["decode_dispatches"],
         "serve_prefill_dispatches": st["prefill_dispatches"],
@@ -401,9 +531,32 @@ def main() -> None:
         "serve_spec_drafted_tokens": st["spec_drafted_tokens"],
         "serve_spec_accepted_tokens": st["spec_accepted_tokens"],
         "serve_spec_acceptance_rate": st["spec_acceptance_rate"],
+        # fault tolerance / overload degradation (serving.faults)
+        "serve_fault_plan": args.fault_plan,
+        "serve_requests_finished": len(eng.finished),
+        "serve_goodput_tok_s": round(good_tokens / wall, 1),
+        "serve_faults_injected": st.get("faults_injected", 0),
+        "serve_admission_rejected": st.get("admission_rejected", 0),
+        "serve_reject_reasons": st.get("reject_reasons", {}),
+        "serve_shed_requests": st.get("shed_requests", 0),
+        "serve_deferred_submits": st.get("deferred_submits", 0),
+        "serve_livelock_parks": st.get("livelock_parks", 0),
+        "serve_overload_parks": st.get("overload_parks", 0),
+        "serve_watchdog_trips": st.get("watchdog_trips", 0),
+        "serve_retries": st.get("retries", 0),
+        "serve_failovers": st.get("failovers", 0),
+        "serve_requeued_requests": st.get("requeued_requests", 0),
+        "serve_dead_replicas": st.get("dead_replicas", 0),
+        "serve_replica_health": st.get(
+            "replica_health", ["healthy"] * len(engines)
+        ),
+        "serve_recovery_s": (
+            round(t_end - first_fault, 3) if first_fault is not None
+            else None
+        ),
+        "serve_error": status_error,
     }
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")
+    run_done.set()  # record complete: main owns the output line now
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
     with open(out, "w") as f:
         json.dump(record, f, indent=1)

@@ -37,6 +37,7 @@ def test_bench_emits_json_error_on_dead_backend():
     rec = json.loads(lines[0])
     assert rec["metric"] == "bench_error"
     assert "error" in rec
+    assert rec["status"] == "error", "a real failure is not a wedge"
 
 
 def test_bench_watchdog_fires_on_hung_init():
@@ -61,6 +62,9 @@ def test_bench_watchdog_fires_on_hung_init():
     assert "NOT_REACHED" not in r.stdout
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["metric"] == "bench_error"
+    # structured wedge row: BENCH_r*.json trajectories separate hardware
+    # wedges (r4/r5) from regressions by this field
+    assert rec["status"] == "watchdog"
 
 
 def test_rung_measure_falls_back_when_scan_compile_fails():
@@ -169,3 +173,4 @@ def test_bench_main_record_flow_with_stubbed_rungs(monkeypatch, capsys):
     assert "decode_tok_s" in rec
     assert "long_ctx_mfu" in rec
     assert rec["measure"] == "chained"
+    assert rec["status"] == "ok"

@@ -445,10 +445,14 @@ def test_growth_capped_at_remaining_budget():
 
 
 def test_engine_rejects_oversized_requests():
+    from midgpt_tpu.serving import AdmissionRejected
+
     model = _model()
     eng = ServingEngine(model, slots=1, page_size=8, window=2)
-    with pytest.raises(AssertionError):
+    with pytest.raises(AdmissionRejected) as exc:
         eng.submit(np.zeros((4,), np.int32), CFG.block_size)  # no room
+    assert exc.value.reason == "budget_exceeds_block"
+    assert eng.stats()["reject_reasons"] == {"budget_exceeds_block": 1}
     # long prompts crop to the last block_size - max_new tokens
     long_prompt = _prompts(1, base_len=CFG.block_size + 10)[0]
     rid = eng.submit(long_prompt, 4)
