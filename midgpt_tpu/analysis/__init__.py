@@ -11,9 +11,12 @@ Layering:
   anywhere, unit-testable in milliseconds against canned fixtures.
 - :mod:`~midgpt_tpu.analysis.harness` imports jax and compiles the real
   train step; :mod:`~midgpt_tpu.analysis.choreo` imports jax and traces
-  the serving programs to jaxprs. Their names are re-exported lazily so
-  ``import midgpt_tpu.analysis`` stays light (the CLI must configure
-  the platform *before* jax loads).
+  the serving programs to jaxprs, and
+  :mod:`~midgpt_tpu.analysis.fusion` /
+  :mod:`~midgpt_tpu.analysis.dispatch` (the scan-equivalence prover and
+  the launch auditor) build on its flattener. Their names are
+  re-exported lazily so ``import midgpt_tpu.analysis`` stays light (the
+  CLI must configure the platform *before* jax loads).
 
 CLI: ``python -m midgpt_tpu.analysis --config <name> --mesh 8`` — see the
 README's "Static sharding analysis" section.
@@ -30,7 +33,12 @@ from midgpt_tpu.analysis.hlo import (
     parse_input_output_alias,
     parse_replica_groups,
 )
-from midgpt_tpu.analysis.budgets import budget_for, check_budget
+from midgpt_tpu.analysis.budgets import (
+    budget_for,
+    check_budget,
+    check_dispatch_budget,
+    dispatch_budget_for,
+)
 from midgpt_tpu.analysis.pylint_pass import Finding, lint_paths, lint_source
 from midgpt_tpu.analysis.traffic import (
     TrafficReport,
@@ -51,10 +59,13 @@ from midgpt_tpu.analysis.rules import (
 _HARNESS_NAMES = (
     "analyze_train_step",
     "audit_config",
+    "audit_serving_dispatch",
     "compile_eval_sweep",
     "compile_train_step",
     "override_logical_rules",
+    "prove_scan_equivalence",
     "prove_serving_choreography",
+    "serving_dispatch_reports",
     "shrink_for_audit",
     "train_step_comms_summary",
 )
@@ -72,6 +83,8 @@ __all__ = [
     "Violation",
     "budget_for",
     "check_budget",
+    "check_dispatch_budget",
+    "dispatch_budget_for",
     "cost_report",
     "floor_decomposition",
     "floor_table_markdown",

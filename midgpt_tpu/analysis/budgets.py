@@ -169,6 +169,90 @@ BUDGETS: tp.Dict[tp.Tuple[str, str, str], tp.Dict[str, int]] = {
 # of the weight stream at this geometry) cannot hide inside it
 TOLERANCE = 0.04
 
+# ---------------------------------------------------------------------------
+# dispatch/launch budgets (analysis.dispatch) — the launch-side twin of
+# the byte budgets above. Keyed (program, layer_scan) at AUDIT_GEOMETRY
+# (n_layer=2 after the audit shrink; the layer-scan trip count IS that
+# depth). Every entry gates EXACTLY (no band — launch structure is
+# integral): the fused cells demand launches_per_window == 1 with the
+# layer loop inside a scan of trip n_layer and ONE inlined layer body;
+# the unrolled cells pin the legacy shape so a half-fused hybrid can't
+# pass either budget. Re-unrolling a fused program moves zero bytes —
+# the byte budgets stay green — but flips inlined_layer_bodies to
+# n_layer and layer_scan_length to 0, tripping the "on" cells.
+# Host transfers are pinned at 0 everywhere (the jaxpr-level twin of
+# the compiled no-host-sync rule).
+# ---------------------------------------------------------------------------
+
+DISPATCH_BUDGETS: tp.Dict[tp.Tuple[str, str], tp.Dict[str, int]] = {
+    ("decode_window", "on"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 1,
+        "layer_scan_length": 2, "host_transfers": 0,
+    },
+    ("decode_window", "off"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 2,
+        "layer_scan_length": 0, "host_transfers": 0,
+    },
+    ("prefill_chunk", "on"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 1,
+        "layer_scan_length": 2, "host_transfers": 0,
+    },
+    ("prefill_chunk", "off"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 2,
+        "layer_scan_length": 0, "host_transfers": 0,
+    },
+    ("verify_program", "on"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 1,
+        "layer_scan_length": 2, "host_transfers": 0,
+    },
+    ("verify_program", "off"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 2,
+        "layer_scan_length": 0, "host_transfers": 0,
+    },
+}
+
+
+def dispatch_budget_for(
+    program: str, layer_scan: str
+) -> tp.Optional[tp.Dict[str, int]]:
+    return DISPATCH_BUDGETS.get((program, layer_scan))
+
+
+def check_dispatch_budget(
+    report,  # dispatch.DispatchReport
+    budget: tp.Mapping[str, int],
+) -> tp.List[str]:
+    """Evaluate one program's measured launch structure against its
+    dispatch budget; returns violation strings (empty = pass). Exact
+    equality, no band: a launch count is an integer, and both
+    directions are regressions (an extra inlined body is re-unrolling;
+    a missing one means the audit traced the wrong program)."""
+    out: tp.List[str] = []
+    got = report.to_dict()
+    for key in (
+        "launches_per_window", "inlined_layer_bodies",
+        "layer_scan_length", "host_transfers",
+    ):
+        expect = budget.get(key)
+        if expect is None:
+            continue
+        if got[key] != expect:
+            hint = ""
+            if key == "inlined_layer_bodies" and got[key] > expect:
+                hint = (
+                    " — the layer loop re-unrolled (every decode "
+                    "dispatch pays per-layer launch overhead again)"
+                )
+            elif key == "layer_scan_length" and got[key] == 0:
+                hint = " — no folded layer scan found in the program"
+            elif key == "host_transfers":
+                hint = " — a host callback joined the hot path"
+            out.append(
+                f"{report.program}: {key} {got[key]} != budget "
+                f"{expect}{hint}"
+            )
+    return out
+
 
 def precision_key(precision: str, kv_quant: bool = False) -> str:
     """Budget-cell precision tag: the weight precision, suffixed

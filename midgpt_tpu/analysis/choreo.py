@@ -194,9 +194,19 @@ def flatten_jaxpr(closed) -> FlatGraph:
                     in_origins=tuple(origin for _, origin in ins),
                 ))
                 continue
+            # include jaxprs nested inside tuple/list params too:
+            # lax.cond's 'branches' is a plain TUPLE of ClosedJaxprs,
+            # which a bare hasattr over params.values() would skip —
+            # arithmetic inside a cond branch would then vanish from
+            # the normalized trace (a vacuous pass, the same blind spot
+            # class the extraction-degeneracy guard exists for). Tuple
+            # params recurse UNALIGNED (fresh origins), the safe
+            # degradation the comment above describes.
             subs = [
-                p for p in eqn.params.values()
-                if hasattr(p, "eqns") or hasattr(p, "jaxpr")
+                c
+                for p in eqn.params.values()
+                for c in (p if isinstance(p, (tuple, list)) else (p,))
+                if hasattr(c, "eqns") or hasattr(c, "jaxpr")
             ]
             nested = [getattr(s, "jaxpr", s) for s in subs]
             if nested:

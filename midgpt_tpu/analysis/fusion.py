@@ -1,0 +1,323 @@
+"""Scan-equivalence prover for the fused serving layer loop.
+
+ROADMAP item 1 folds the per-layer loop of the three serving programs
+(decode window / prefill chunk / speculative verify) into one
+``lax.scan`` (``layer_scan="on"``, models.gpt). The fold is an
+arithmetic-touching rewrite of the hottest path in the engine, and this
+repo's hard-won rule (PRs 4/5/6/8) is that such rewrites only land
+behind a machine-checked static gate. This module is that gate — the
+SIXTH audit family, next to donation / host-sync / dequant /
+choreography / traffic:
+
+1. **Layer homogeneity** — the unrolled program's per-layer normalized
+   op-and-dtype traces (choreo.py's extractor: float arithmetic only,
+   shapes dropped, weight matmuls classified by entry-parameter origin)
+   are IDENTICAL, layer for layer. That is the precondition that makes
+   the fold legal at all: ``lax.scan`` runs ONE body L times, so a
+   program whose layers differ (a per-layer dtype special case, a
+   depth-dependent branch) cannot be folded without changing what some
+   layer computes. Checked twice, at two granularities: the attention
+   regions (the subgraph the choreography contracts live in) and the
+   FULL per-layer trace segment (everything between consecutive layers'
+   first weight projections — attention + MLP + the following norm).
+2. **Fold structure** — the fused program's flat trace contains exactly
+   ONE inlined layer body (the scan body, traced once), i.e. the loop
+   really did fold; a re-unrolled "fused" program shows L bodies and
+   fails here before any dispatch budget looks at it.
+3. **Scan-body equivalence** — the fused program's single layer body is
+   op-for-op equal to the unrolled program's per-layer trace (attention
+   region, full segment, softmax signature, lm-head choreography), the
+   same way choreo.py proves verify ≡ decode. A dtype drift that exists
+   only on the scan path — the exact class of bug a fused rewrite can
+   introduce while the unrolled path stays green — turns this red
+   before anything compiles.
+
+Everything operates on jaxprs through :mod:`~midgpt_tpu.analysis.choreo`'s
+flattener (no compilation, no execution); a full three-program proof of
+both layer_scan values runs in seconds on CPU. The runtime side of the
+gate is the bitwise on-vs-off token-identity matrix in
+``tests/test_serving.py`` / ``test_serving_sharded.py``; the launch-count
+side is :mod:`~midgpt_tpu.analysis.dispatch`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as tp
+
+from midgpt_tpu.analysis.choreo import (
+    FlatGraph,
+    SoftmaxSignature,
+    TraceRec,
+    _FLOAT_DTYPES,
+    _dot_kind,
+    _first_diff,
+    attention_regions,
+    flatten_jaxpr,
+    kernel_choreography,
+    normalized_trace,
+    softmax_signature,
+)
+
+PROGRAMS = ("decode_window", "prefill_chunk", "verify")
+
+
+def layer_segments(
+    trace: tp.Sequence[TraceRec], n_layers: int
+) -> tp.Optional[tp.List[tp.Tuple[TraceRec, ...]]]:
+    """Split a full normalized trace into per-layer segments.
+
+    Layer boundaries are the weight projections ('proj' records): every
+    transformer layer contracts the same fixed set of weight matrices
+    (wqkv, wo, w_up, w_down[, w_gate]) and the program ends with exactly
+    one lm-head projection, so with P = (total_projs - 1) / n_layers
+    projections per layer, layer i's segment spans from its FIRST proj
+    to just before layer i+1's first proj (the last layer's segment ends
+    at the lm-head proj). A segment therefore carries the layer's whole
+    arithmetic — attention, MLP, and the RMSNorm records that precede
+    the NEXT first-proj (which for the last layer is ``ln_f``, the same
+    weightless-RMSNorm op sequence as a block's ``ln1``). Pre-layer
+    records (rope-row casts, embedding) sit before the first proj and
+    are excluded; post-head records (sampling, acceptance) come after
+    the last boundary and are excluded.
+
+    Returns ``None`` when the trace does not have the expected proj
+    structure (not enough projections, or a count that does not divide
+    into ``n_layers`` equal groups) — the caller reports that as a
+    failed check, never as a vacuous pass."""
+    projs = [i for i, rec in enumerate(trace) if rec[0] == "proj"]
+    if n_layers < 1 or len(projs) < n_layers + 1:
+        return None
+    if (len(projs) - 1) % n_layers:
+        return None
+    per = (len(projs) - 1) // n_layers
+    return [
+        tuple(trace[projs[i * per] : projs[(i + 1) * per]])
+        for i in range(n_layers)
+    ]
+
+
+def _program_softmax(
+    name: str, graph: FlatGraph
+) -> tp.Optional[SoftmaxSignature]:
+    """The program's softmax-core signature — from the Pallas kernel
+    body when the attention is kernelized, from the first float ``exp``
+    otherwise. Unlike ``extract_choreography`` this does NOT assert
+    cross-layer equality (homogeneity is this module's own soft check);
+    returns ``None`` when no softmax is found (reported as a failure)."""
+    kernels = [k for k in graph.kernels if k is not None]
+    if kernels:
+        return kernel_choreography(name, kernels[0])
+    exps = [
+        op for op in graph.ops
+        if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+    ]
+    if not exps:
+        return None
+    return softmax_signature(graph, exps[0])
+
+
+def _program_lm_head(
+    graph: FlatGraph,
+) -> tp.Tuple[tp.Optional[TraceRec], bool]:
+    """The last weight projection in program order + whether the
+    quantized dequant-epilogue multiply follows it (the same extraction
+    ``extract_choreography`` performs)."""
+    lm_op = None
+    for op in graph.ops:
+        if op.prim == "dot_general" and _dot_kind(op) == "proj":
+            lm_op = op
+    if lm_op is None:
+        return None, False
+    epilogue = any(
+        c.prim == "mul" and "invar" in c.in_origins
+        for c in graph.consumers.get(lm_op.out_ids[0], [])
+    )
+    return ("proj", lm_op.in_dtypes, lm_op.out_dtypes), epilogue
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionCheck:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionReport:
+    """The scan-equivalence proof over the three serving programs."""
+
+    checks: tp.Tuple[FusionCheck, ...]
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    def to_dict(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "ok": self.ok,
+            "checks": [
+                {"name": c.name, "ok": c.ok, "detail": c.detail}
+                for c in self.checks
+            ],
+        }
+
+
+def _segment_diff(
+    a: tp.Optional[tp.Sequence], b: tp.Optional[tp.Sequence]
+) -> str:
+    if a is None or b is None:
+        return "segmentation failed"
+    return _first_diff(tuple(a), tuple(b)) or ""
+
+
+def prove_program_fusion(
+    name: str, unrolled_jaxpr, fused_jaxpr
+) -> tp.List[FusionCheck]:
+    """The per-program checks: homogeneity of the unrolled trace, fold
+    structure of the fused trace, and scan-body ≡ per-layer equivalence
+    between the two."""
+    checks: tp.List[FusionCheck] = []
+    un_graph = flatten_jaxpr(unrolled_jaxpr)
+    fu_graph = flatten_jaxpr(fused_jaxpr)
+    un_regions = attention_regions(un_graph)
+    fu_regions = attention_regions(fu_graph)
+    n_layers = len(un_regions)
+    un_trace = normalized_trace(un_graph)
+    fu_trace = normalized_trace(fu_graph)
+    un_segs = layer_segments(un_trace, n_layers) if n_layers else None
+    fu_segs = layer_segments(fu_trace, 1)
+
+    # 1a. homogeneity at attention granularity
+    hetero = ""
+    for i, r in enumerate(un_regions[1:], start=2):
+        if tuple(r) != tuple(un_regions[0]):
+            hetero = (
+                f"layer {i} vs layer 1: "
+                f"{_first_diff(tuple(un_regions[0]), tuple(r))}"
+            )
+            break
+    checks.append(FusionCheck(
+        name=f"{name}: unrolled layers are homogeneous (attention)",
+        ok=n_layers >= 2 and not hetero,
+        detail=hetero or (f"only {n_layers} attention region(s) found"
+                          if n_layers < 2 else ""),
+    ))
+    # 1b. homogeneity over the FULL per-layer segment
+    seg_detail = ""
+    seg_ok = un_segs is not None and len(un_segs) == n_layers
+    if seg_ok:
+        for i, s in enumerate(un_segs[1:], start=2):
+            if s != un_segs[0]:
+                seg_ok = False
+                seg_detail = (
+                    f"layer {i} vs layer 1: "
+                    f"{_first_diff(un_segs[0], s)}"
+                )
+                break
+    else:
+        seg_detail = (
+            "per-layer segmentation failed (projection structure does "
+            f"not divide into {n_layers} equal layers)"
+        )
+    checks.append(FusionCheck(
+        name=f"{name}: unrolled layers are homogeneous (full trace)",
+        ok=seg_ok,
+        detail=seg_detail,
+    ))
+
+    # 2. the fused program really folded the loop: ONE inlined body
+    fold_ok = len(fu_regions) == 1 and fu_segs is not None
+    checks.append(FusionCheck(
+        name=f"{name}: fused program folds the layer loop into one body",
+        ok=fold_ok,
+        detail=(
+            "" if fold_ok
+            else (
+                f"{len(fu_regions)} inlined layer bodies in the fused "
+                "trace (1 = folded; the unrolled count means the scan "
+                "did not fold)"
+                if len(fu_regions) != 1
+                else "segmentation failed"
+            )
+        ),
+    ))
+
+    # 3a. scan body ≡ per-layer trace, attention region
+    att_diff = (
+        _first_diff(tuple(un_regions[0]), tuple(fu_regions[0]))
+        if un_regions and fu_regions
+        else "missing attention region"
+    )
+    checks.append(FusionCheck(
+        name=f"{name}: scan body equals the per-layer trace (attention)",
+        ok=bool(un_regions and fu_regions) and not att_diff,
+        detail=att_diff,
+    ))
+    # 3b. ... and over the full layer segment
+    full_ok = (
+        un_segs is not None and fu_segs is not None
+        and fu_segs[0] == un_segs[0]
+    )
+    checks.append(FusionCheck(
+        name=f"{name}: scan body equals the per-layer trace (full segment)",
+        ok=full_ok,
+        detail=(
+            "" if full_ok
+            else _segment_diff(
+                un_segs[0] if un_segs else None,
+                fu_segs[0] if fu_segs else None,
+            )
+        ),
+    ))
+    # 3c. softmax-core signature (the PR 4/5 bug-class granularity) +
+    # extraction-degeneracy guard: an unreadable signature is a
+    # violation, never a vacuous pass (the PR 9 lesson)
+    un_sig = _program_softmax(f"{name}/unrolled", un_graph)
+    fu_sig = _program_softmax(f"{name}/fused", fu_graph)
+    degenerate = (
+        un_sig is None or fu_sig is None
+        or not un_sig.qk_contracts or not fu_sig.qk_contracts
+        or not un_sig.pv_contracts or not fu_sig.pv_contracts
+    )
+    checks.append(FusionCheck(
+        name=f"{name}: scan body softmax signature equals per-layer",
+        ok=not degenerate and un_sig == fu_sig,
+        detail=(
+            "degenerate signature extraction (no score/PV contractions "
+            "visible to the prover)" if degenerate
+            else (
+                "" if un_sig == fu_sig
+                else f"{un_sig.describe()} != {fu_sig.describe()}"
+            )
+        ),
+    ))
+    # 3d. lm-head choreography unchanged by the fold
+    un_lm = _program_lm_head(un_graph)
+    fu_lm = _program_lm_head(fu_graph)
+    checks.append(FusionCheck(
+        name=f"{name}: lm-head choreography unchanged by the fold",
+        ok=un_lm == fu_lm and un_lm[0] is not None,
+        detail=f"unrolled {un_lm} != fused {fu_lm}" if un_lm != fu_lm
+        else ("no lm-head projection found" if un_lm[0] is None else ""),
+    ))
+    return checks
+
+
+def prove_scan_fusion(
+    unrolled: tp.Mapping[str, tp.Any],
+    fused: tp.Mapping[str, tp.Any],
+) -> FusionReport:
+    """Prove all three serving programs' scan-equivalence contracts.
+    ``unrolled``/``fused`` map program name -> traced ClosedJaxpr
+    (``serving.engine.trace_serving_programs`` with ``layer_scan`` off
+    and on respectively — the very jitted callables the engine launches)."""
+    checks: tp.List[FusionCheck] = []
+    for prog in PROGRAMS:
+        assert prog in unrolled and prog in fused, (
+            f"missing program {prog!r} in the traced set"
+        )
+        checks.extend(
+            prove_program_fusion(prog, unrolled[prog], fused[prog])
+        )
+    return FusionReport(checks=tuple(checks))

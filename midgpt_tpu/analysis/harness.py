@@ -445,6 +445,7 @@ def compile_decode_window(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's fused K-step decode window
@@ -480,6 +481,7 @@ def compile_decode_window(
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
         rope_len=model_cfg.block_size, mesh=prog_mesh,
+        layer_scan=layer_scan,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = window_fn.lower(
@@ -514,6 +516,7 @@ def audit_decode_window(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -531,7 +534,7 @@ def audit_decode_window(
         compile_decode_window(
             cfg, slots=slots, window=window, page_size=page_size,
             shrink=shrink, quant=quant, kv_quant=kv_quant,
-            mesh_shape=mesh_shape,
+            layer_scan=layer_scan, mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -557,6 +560,7 @@ def compile_prefill_chunk(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's prefill-chunk program
@@ -588,6 +592,7 @@ def compile_prefill_chunk(
     chunk_fn = make_prefill_chunk_program(
         model, chunk_len=chunk_len, pmax=pmax,
         rope_len=model_cfg.block_size, mesh=prog_mesh,
+        layer_scan=layer_scan,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = chunk_fn.lower(
@@ -618,6 +623,7 @@ def audit_prefill_chunk(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -635,7 +641,8 @@ def audit_prefill_chunk(
     hlo, mesh, donated, block, wshapes, payload, keys = (
         compile_prefill_chunk(
             cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
-            quant=quant, kv_quant=kv_quant, mesh_shape=mesh_shape,
+            quant=quant, kv_quant=kv_quant, layer_scan=layer_scan,
+            mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -662,6 +669,7 @@ def compile_verify_program(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's speculative VERIFY program
@@ -693,6 +701,7 @@ def compile_verify_program(
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
         rope_len=model_cfg.block_size, mesh=prog_mesh,
+        layer_scan=layer_scan,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = verify_fn.lower(
@@ -725,6 +734,7 @@ def audit_verify_program(
     shrink: bool = True,
     quant: bool = False,
     kv_quant: bool = False,
+    layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -742,7 +752,7 @@ def audit_verify_program(
         compile_verify_program(
             cfg, slots=slots, spec_len=spec_len, page_size=page_size,
             shrink=shrink, quant=quant, kv_quant=kv_quant,
-            mesh_shape=mesh_shape,
+            layer_scan=layer_scan, mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -859,6 +869,160 @@ ChoreoReport`.
         naive=extract_choreography("naive_reference", naive_jaxpr),
         expect_kv_dequant=kv_quant,
     )
+
+
+def prove_scan_equivalence(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    quant: bool = False,
+    kv_quant: bool = False,
+    paged_kernel: str = "xla",
+    n_layer: int = 3,
+):
+    """Run the scan-equivalence prover (:mod:`midgpt_tpu.analysis.fusion`)
+    over the three serving programs of ``cfg``'s model family: trace
+    each program BOTH ways (``layer_scan`` off and on, through the very
+    jitted factories the engine launches), prove the unrolled traces
+    layer-homogeneous (the fold's legality precondition), and prove the
+    fused scan BODY's normalized trace op-for-op equal to the unrolled
+    per-layer trace — attention region, full layer segment, softmax
+    signature, lm-head choreography. Returns a
+    :class:`~midgpt_tpu.analysis.fusion.FusionReport`.
+
+    Traced at depth 3 (not the choreography size's 2): homogeneity
+    needs a TRUE MIDDLE layer — at depth 2 every layer is first or
+    last, and a first/last-layer special case would have nothing
+    identical to be compared against. No compilation; a full proof of
+    all six traces is seconds on CPU."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.analysis.fusion import prove_scan_fusion
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving.engine import trace_serving_programs
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    model_cfg = _dc.replace(
+        cfg.model, n_layer=n_layer, block_size=64, vocab_size=128,
+        remat="none", scan_unroll=1,
+    )
+    model = cast_floating(
+        GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16
+    )
+    if quant:
+        from midgpt_tpu.quant import quantize_model
+
+        model = quantize_model(model)
+    kw = dict(
+        slots=4, window=2, spec_len=2, chunk_len=16, page_size=16,
+        kv_quant="int8" if kv_quant else None, paged_kernel=paged_kernel,
+    )
+    off = trace_serving_programs(model, layer_scan="off", **kw)
+    on = trace_serving_programs(model, layer_scan="on", **kw)
+    return prove_scan_fusion(off, on)
+
+
+def serving_dispatch_reports(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    layer_scan: str = "off",
+    quant: bool = False,
+    kv_quant: bool = False,
+    paged_kernel: str = "xla",
+    slots: int = 4,
+    window: int = 4,
+    spec_len: int = 4,
+    chunk_len: int = 64,
+    page_size: int = 16,
+) -> tp.Dict[str, tp.Any]:
+    """Trace the three serving programs at the audit geometry (the same
+    n_layer=2 shrink the byte budgets were measured at) and build their
+    static :class:`~midgpt_tpu.analysis.dispatch.DispatchReport`\\ s,
+    keyed by the budget program names (``decode_window`` /
+    ``prefill_chunk`` / ``verify_program``). Launch structure is
+    precision-independent (quant/kv-quant change dtypes, not the scan
+    nesting) — the flags exist so fault-injection tests can audit any
+    cell they traced."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.analysis.dispatch import dispatch_report
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving.engine import trace_serving_programs
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    model_cfg = _dc.replace(
+        cfg.model, n_layer=2, block_size=256, vocab_size=1024,
+        remat="none", scan_unroll=1,
+    )
+    model = cast_floating(
+        GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16
+    )
+    if quant:
+        from midgpt_tpu.quant import quantize_model
+
+        model = quantize_model(model)
+    jaxprs = trace_serving_programs(
+        model, slots=slots, window=window, spec_len=spec_len,
+        chunk_len=chunk_len, page_size=page_size,
+        kv_quant="int8" if kv_quant else None,
+        paged_kernel=paged_kernel, layer_scan=layer_scan,
+    )
+    return {
+        "decode_window": dispatch_report(
+            jaxprs["decode_window"], program="decode_window",
+            window_steps=window,
+        ),
+        "prefill_chunk": dispatch_report(
+            jaxprs["prefill_chunk"], program="prefill_chunk",
+        ),
+        "verify_program": dispatch_report(
+            jaxprs["verify"], program="verify_program",
+        ),
+    }
+
+
+def audit_serving_dispatch(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    layer_scan: str = "off",
+    **kw,
+) -> tp.Tuple[tp.Dict[str, tp.Any], tp.List[str]]:
+    """One-call dispatch audit: trace the three programs with the given
+    ``layer_scan`` and gate their launch structure against the
+    checked-in :data:`~midgpt_tpu.analysis.budgets.DISPATCH_BUDGETS`
+    cells for that value. Returns ``(reports, violations)`` — the CI
+    serving-choreo job runs this for BOTH values, so a re-unrolled
+    fused program (zero byte movement, L× launch structure) fails the
+    "on" cells before any hardware sees it."""
+    from midgpt_tpu.analysis.budgets import (
+        check_dispatch_budget,
+        dispatch_budget_for,
+    )
+
+    reports = serving_dispatch_reports(
+        name_or_cfg, layer_scan=layer_scan, **kw
+    )
+    violations: tp.List[str] = []
+    for name, rep in reports.items():
+        budget = dispatch_budget_for(name, layer_scan)
+        if budget is not None:
+            violations.extend(check_dispatch_budget(rep, budget))
+    return reports, violations
 
 
 def train_step_comms_summary(cfg: ExperimentConfig) -> tp.Dict[str, tp.Any]:

@@ -58,6 +58,10 @@ RULES = {
         "serving jit captures the model instead of taking it as a "
         "parameter"
     ),
+    "no-unrolled-layer-loop": (
+        "serving jit unrolls a Python for-loop over model layers "
+        "instead of using the lax.scan layer fold"
+    ),
 }
 
 # call targets whose function arguments are traced/compiled
@@ -373,6 +377,47 @@ class _ModuleLint:
                         )
 
 
+    def check_unrolled_layer_loop(self) -> None:
+        """``no-unrolled-layer-loop`` (serving modules only, waivable):
+        a Python-level ``for`` over the model's layers inside jitted/
+        traced serving code. The layer fold exists
+        (models.gpt ``layer_scan="on"``, proven bitwise and gated by
+        analysis.fusion/dispatch) — a new serving program body that
+        unrolls ``for i in range(cfg.n_layer)`` re-introduces the L×
+        per-layer launch structure the fold removed, silently (zero
+        byte movement, so only the dispatch budget or this lint sees
+        it). The models/ drivers keep their unrolled ``layer_scan=
+        "off"`` branches on purpose (the off path is the fold's
+        bitwise reference); this rule scopes to ``midgpt_tpu/serving/``
+        where program BODIES live."""
+        def mentions_layers(node: ast.AST) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and sub.attr == "n_layer":
+                    return True
+                if isinstance(sub, ast.Name) and sub.id == "n_layer":
+                    return True
+            return False
+
+        reported: tp.Set[int] = set()
+        for root in self._traced_roots():
+            for node in ast.walk(root):
+                if not isinstance(node, (ast.For, ast.AsyncFor)):
+                    continue
+                if node.lineno in reported:
+                    continue
+                if mentions_layers(node.iter):
+                    reported.add(node.lineno)
+                    self.add(
+                        node.lineno,
+                        "no-unrolled-layer-loop",
+                        "Python for-loop over model layers in a traced "
+                        "serving body — use the lax.scan layer fold "
+                        "(models.gpt layer_scan) so decode dispatch "
+                        "structure stays 1 inlined body per program "
+                        "(gated by analysis.dispatch budgets)",
+                    )
+
+
 def _free_names(fn: ast.AST) -> tp.Set[str]:
     """Names a function LOADS but never binds — its closure/global
     captures, to the static approximation one module allows. Scope-
@@ -449,6 +494,10 @@ def lint_source(source: str, path: str = "<string>") -> tp.List[Finding]:
     # trainers legitimately close over config-derived structures
     if "serving" in Path(path).parts:
         lint.check_model_closure()
+        # same scope for the layer-loop rule: serving program bodies
+        # must take the scan fold; the models/ drivers keep their
+        # unrolled branch as the fold's bitwise reference
+        lint.check_unrolled_layer_loop()
     waivers = _pragma_waivers(source)
     findings = []
     for lineno, rule, message in sorted(lint.findings):

@@ -1774,6 +1774,7 @@ def decode_step_paged(
     pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
     pool_sv: tp.Optional[Array] = None,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ) -> tp.Tuple[Array, Array, Array]:
     """One decode step of the continuous-batching engine: every slot
     attends over its OWN block-table pages (positions < pooled_len[s])
@@ -1783,7 +1784,23 @@ def decode_step_paged(
     rows into the pages in one bulk scatter at window end (the same
     read-only-cache discipline as ``decode_step_recent``). Unlike the
     ring sampler there is no sliding window: pages are append-only and
-    the engine caps each request at ``block_size`` total tokens."""
+    the engine caps each request at ``block_size`` total tokens.
+
+    ``layer_scan="on"`` folds the layer loop into ONE ``lax.scan`` over
+    the stacked block params (ROADMAP item 1: the unrolled loop
+    re-dispatches the whole per-layer kernel set L times per step —
+    launch overhead the [B, 1, D] decode shapes cannot hide). The
+    read-only pool planes, scale planes and recent buffers ride as
+    per-layer scan ``xs`` (a dynamic-slice read per iteration — nothing
+    re-stacks, unlike carrying a written cache through a scan, the
+    decode_step trap) and each iteration returns its layer's updated
+    recent rows as ``ys``. The body calls the very same
+    ``Block.decode_paged_at`` as the unrolled path, on a ``[1, ...]``
+    per-layer view with ``layer=0``, so the scan BODY's arithmetic is
+    op-for-op the per-layer trace — which the scan-equivalence prover
+    (midgpt_tpu.analysis.fusion, CI serving-choreo job) proves
+    statically and the bitwise on-vs-off token-identity matrix pins at
+    runtime."""
     cfg = model.config
     s = tokens.shape[0]
     pmax = bt.shape[1]
@@ -1817,13 +1834,34 @@ def decode_step_paged(
 
     h = embed_tokens(model.wte, tokens[:, None])  # [S, 1, D]
     sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
-    for i in range(cfg.n_layer):
-        block = jax.tree.map(lambda a: a[i], model.blocks)
-        h, rk, rv = block.decode_paged_at(
-            h, pool_k, pool_v, bt, rk, rv, i, r, mask_pool, mask_rec,
-            sin_h, cos_h, pooled_len=pooled_len, pool_sk=pool_sk,
-            pool_sv=pool_sv, paged_kernel=paged_kernel,
-        )
+    assert layer_scan in ("on", "off"), layer_scan
+    if layer_scan == "on":
+        quant = pool_sk is not None
+
+        def body(hc, xs):
+            block, pk_l, pv_l, rk_l, rv_l = xs[:5]
+            sk_l = xs[5][None] if quant else None
+            sv_l = xs[6][None] if quant else None
+            hc, rk1, rv1 = block.decode_paged_at(
+                hc, pk_l[None], pv_l[None], bt, rk_l[None], rv_l[None],
+                0, r, mask_pool, mask_rec, sin_h, cos_h,
+                pooled_len=pooled_len, pool_sk=sk_l, pool_sv=sv_l,
+                paged_kernel=paged_kernel,
+            )
+            return hc, (rk1[0], rv1[0])
+
+        xs = (model.blocks, pool_k, pool_v, rk, rv)
+        if quant:
+            xs = xs + (pool_sk, pool_sv)
+        h, (rk, rv) = jax.lax.scan(body, h, xs)
+    else:
+        for i in range(cfg.n_layer):
+            block = jax.tree.map(lambda a: a[i], model.blocks)
+            h, rk, rv = block.decode_paged_at(
+                h, pool_k, pool_v, bt, rk, rv, i, r, mask_pool, mask_rec,
+                sin_h, cos_h, pooled_len=pooled_len, pool_sk=pool_sk,
+                pool_sv=pool_sv, paged_kernel=paged_kernel,
+            )
     h = model.ln_f(h)
     # vocab-sharded logits (TP lm head is column-parallel): nothing here
     # gathers the [S, V] row — greedy argmax partitions over 'tensor'
@@ -1841,6 +1879,7 @@ def prefill_chunk_paged(
     rope_len: int,
     pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
     pool_sv: tp.Optional[Array] = None,
+    layer_scan: str = "off",
 ) -> tp.Tuple[Array, Array, Array]:
     """Suffix-only prefill of one chunk against a pre-populated block
     table: the chunk's tokens (context positions ``start .. start+T-1``)
@@ -1889,18 +1928,42 @@ def prefill_chunk_paged(
 
     h = embed_tokens(model.wte, tokens)  # [1, T, D]
     sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
-    ks, vs = [], []
-    for i in range(cfg.n_layer):
-        block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
-        h, k, v = block.prefill_paged_at(
-            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h,
-            start=start, pool_sk=pool_sk, pool_sv=pool_sv,
-        )
-        ks.append(k)
-        vs.append(v)
+    assert layer_scan in ("on", "off"), layer_scan
+    if layer_scan == "on":
+        # layer loop folded into one lax.scan (see decode_step_paged):
+        # read-only pool/scale planes ride as xs, the chunk's per-layer
+        # K/V land as scan ys — exactly the jnp.stack of the unrolled
+        # loop, produced a layer at a time
+        quant = pool_sk is not None
+
+        def body(hc, xs):
+            block, pk_l, pv_l = xs[:3]
+            sk_l = xs[3][None] if quant else None
+            sv_l = xs[4][None] if quant else None
+            hc, k, v = block.prefill_paged_at(
+                hc, pk_l[None], pv_l[None], bt, 0, mask_pool, mask_self,
+                sin_h, cos_h, start=start, pool_sk=sk_l, pool_sv=sv_l,
+            )
+            return hc, (k, v)
+
+        xs = (model.blocks, pool_k, pool_v)
+        if quant:
+            xs = xs + (pool_sk, pool_sv)
+        h, (ks, vs) = jax.lax.scan(body, h, xs)
+    else:
+        ks, vs = [], []
+        for i in range(cfg.n_layer):
+            block = jax.tree.map(lambda a: a[i], model.blocks)  # static
+            h, k, v = block.prefill_paged_at(
+                h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h,
+                cos_h, start=start, pool_sk=pool_sk, pool_sv=pool_sv,
+            )
+            ks.append(k)
+            vs.append(v)
+        ks, vs = jnp.stack(ks), jnp.stack(vs)
     h = model.ln_f(h)
-    ks = shard_act(jnp.stack(ks), None, None, "kv_heads", None, None)
-    vs = shard_act(jnp.stack(vs), None, None, "kv_heads", None, None)
+    ks = shard_act(ks, None, None, "kv_heads", None, None)
+    vs = shard_act(vs, None, None, "kv_heads", None, None)
     return h, ks, vs  # ks/vs: [L, 1, Hkv, T, C]
 
 
@@ -1916,6 +1979,7 @@ def verify_tokens_paged(
     pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
     pool_sv: tp.Optional[Array] = None,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ) -> tp.Tuple[Array, Array, Array]:
     """Speculative-decoding VERIFICATION forward: score every slot's
     ``[T = spec_len + 1]`` candidate rows (the true next token + the
@@ -1971,22 +2035,45 @@ def verify_tokens_paged(
 
     h = embed_tokens(model.wte, tokens)  # [S, T, D]
     sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
-    ks, vs = [], []
-    for i in range(cfg.n_layer):
-        block = jax.tree.map(lambda a: a[i], model.blocks)  # static slices
-        h, k, v = block.verify_paged_at(
-            h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h, cos_h,
-            start=start, pool_sk=pool_sk, pool_sv=pool_sv,
-            paged_kernel=paged_kernel,
-        )
-        ks.append(k)
-        vs.append(v)
+    assert layer_scan in ("on", "off"), layer_scan
+    if layer_scan == "on":
+        # layer loop folded into one lax.scan (see decode_step_paged):
+        # the candidate rows' per-layer K/V land as scan ys
+        quant = pool_sk is not None
+
+        def body(hc, xs):
+            block, pk_l, pv_l = xs[:3]
+            sk_l = xs[3][None] if quant else None
+            sv_l = xs[4][None] if quant else None
+            hc, k, v = block.verify_paged_at(
+                hc, pk_l[None], pv_l[None], bt, 0, mask_pool, mask_self,
+                sin_h, cos_h, start=start, pool_sk=sk_l, pool_sv=sv_l,
+                paged_kernel=paged_kernel,
+            )
+            return hc, (k, v)
+
+        xs = (model.blocks, pool_k, pool_v)
+        if quant:
+            xs = xs + (pool_sk, pool_sv)
+        h, (ks, vs) = jax.lax.scan(body, h, xs)
+    else:
+        ks, vs = [], []
+        for i in range(cfg.n_layer):
+            block = jax.tree.map(lambda a: a[i], model.blocks)  # static
+            h, k, v = block.verify_paged_at(
+                h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h,
+                cos_h, start=start, pool_sk=pool_sk, pool_sv=pool_sv,
+                paged_kernel=paged_kernel,
+            )
+            ks.append(k)
+            vs.append(v)
+        ks, vs = jnp.stack(ks), jnp.stack(vs)
     h = model.ln_f(h)
     # vocab-sharded per-row logits (column-parallel head) — acceptance
     # argmaxes partition over 'tensor', no gathered [S, T, V] buffer
     logits = shard_act(model.project(h), None, None, "vocab")  # [S, T, V]
-    ks = shard_act(jnp.stack(ks), None, None, "kv_heads", None, None)
-    vs = shard_act(jnp.stack(vs), None, None, "kv_heads", None, None)
+    ks = shard_act(ks, None, None, "kv_heads", None, None)
+    vs = shard_act(vs, None, None, "kv_heads", None, None)
     return logits, ks, vs  # ks/vs: [L, S, Hkv, T, C]
 
 

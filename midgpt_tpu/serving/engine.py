@@ -56,6 +56,14 @@ PERF.md r5) once per generated token. This engine replaces both:
   swap boundary. Dispatches per generated token drop from 1 per token to
   1/K per active batch.
 
+- **Fused layer scan** (``layer_scan="on"``, models.gpt): every
+  program's per-layer loop folds into ONE ``lax.scan`` over the stacked
+  block params — one inlined layer body per program instead of L, the
+  launch structure the decode residual over the HBM floor is made of.
+  Bitwise the unrolled programs (the scan body calls the same per-layer
+  methods on per-layer xs views), gated by the analysis.fusion
+  scan-equivalence prover + the analysis.dispatch launch budgets.
+
 Determinism contract: per-request sampling keys derive from
 ``fold_in(fold_in(key, request_seed), tokens_emitted_so_far)`` — the token
 stream of a request is a function of the request alone, independent of
@@ -167,13 +175,15 @@ def make_decode_window(
     top_k: tp.Optional[int] = None,
     mesh=None,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ):
-    # paged_kernel sits BEFORE the mesh fingerprint: the fingerprint
-    # stays the key's last element (the cache-distinctness test and any
-    # cache introspection key off that position)
+    # paged_kernel/layer_scan sit BEFORE the mesh fingerprint: the
+    # fingerprint stays the key's last element (the cache-distinctness
+    # test and any cache introspection key off that position)
     key = (
         "decode_window", model.config, slots, window, pmax, rope_len,
-        pad_id, temperature, top_k, paged_kernel, _mesh_key(mesh),
+        pad_id, temperature, top_k, paged_kernel, layer_scan,
+        _mesh_key(mesh),
     )
     return _cached_program(
         key,
@@ -181,6 +191,7 @@ def make_decode_window(
             model.config, slots=slots, window=window, pmax=pmax,
             rope_len=rope_len, pad_id=pad_id, temperature=temperature,
             top_k=top_k, mesh=mesh, paged_kernel=paged_kernel,
+            layer_scan=layer_scan,
         ),
     )
 
@@ -197,9 +208,14 @@ def _build_decode_window(
     top_k: tp.Optional[int],
     mesh,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ):
     """The fused K-step decode program: ONE jitted, pool/logits-donating
     ``lax.scan`` over ``window`` whole-model decode steps.
+    ``layer_scan="on"`` additionally folds each step's layer loop into
+    one inner ``lax.scan`` (models.gpt.decode_step_paged — bitwise the
+    unrolled program, gated by the analysis.fusion scan-equivalence
+    prover and the analysis.dispatch launch budgets).
 
     Per scan step: sample each slot's next token from the carried logits,
     mark slots that just hit EOS/length done, run the paged decode step
@@ -281,6 +297,7 @@ def _build_decode_window(
                     model, tok, pos, pool.k, pool.v, bt, rk, rv, r,
                     pooled_len, rope_len, pool_sk=pool.scale_k,
                     pool_sv=pool.scale_v, paged_kernel=paged_kernel,
+                    layer_scan=layer_scan,
                 )
                 # the carry is f32 regardless of compute dtype (an exact
                 # widening — sampling sees the same values either way)
@@ -311,23 +328,25 @@ def _build_decode_window(
 
 
 def make_prefill_chunk_program(
-    model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None
+    model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None,
+    layer_scan: str = "off",
 ):
     key = (
         "prefill_chunk", model.config, chunk_len, pmax, rope_len,
-        _mesh_key(mesh),
+        layer_scan, _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_prefill_chunk_program(
             model.config, chunk_len=chunk_len, pmax=pmax,
-            rope_len=rope_len, mesh=mesh,
+            rope_len=rope_len, mesh=mesh, layer_scan=layer_scan,
         ),
     )
 
 
 def _build_prefill_chunk_program(
-    cfg, *, chunk_len: int, pmax: int, rope_len: int, mesh
+    cfg, *, chunk_len: int, pmax: int, rope_len: int, mesh,
+    layer_scan: str = "off",
 ):
     """A prefill-chunk program for one padded chunk length: one forward
     over the chunk's tokens attending to the slot's already-resident
@@ -359,10 +378,8 @@ def _build_prefill_chunk_program(
             h, ks, vs = prefill_chunk_paged(
                 model, tokens, start, pool.k, pool.v, bt_row[None, :],
                 rope_len, pool_sk=pool.scale_k, pool_sv=pool.scale_v,
+                layer_scan=layer_scan,
             )  # h: [1, T, D]; ks/vs: [L, 1, Hkv, T, C]
-            pool = write_token_rows(
-                pool, ks[:, 0], vs[:, 0], bt_row, start, real_n
-            )
             h_last = jax.lax.dynamic_slice_in_dim(
                 h, real_n - 1, 1, axis=1
             )[:, 0]  # [1, D]
@@ -375,6 +392,16 @@ def _build_prefill_chunk_program(
                 logits, row[None], (slot, jnp.zeros((), slot.dtype))
             )
             logits = shard_act(logits, None, "vocab")
+            # page write AFTER the head projection (no data dependence
+            # between them — a pure trace reorder): the lm head is the
+            # trace's last weight projection in every serving program,
+            # which is the layer-boundary structure the scan-equivalence
+            # prover's per-layer segmentation keys on (an int8 pool's
+            # page-birth quantization arithmetic would otherwise land
+            # inside the LAST layer's segment and break homogeneity)
+            pool = write_token_rows(
+                pool, ks[:, 0], vs[:, 0], bt_row, start, real_n
+            )
         return pool, logits
 
     return jax.jit(chunk_fn, donate_argnums=(1, 2))
@@ -390,17 +417,18 @@ def make_verify_program(
     pad_id: int = 0,
     mesh=None,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ):
     key = (
         "verify", model.config, slots, spec_len, pmax, rope_len, pad_id,
-        paged_kernel, _mesh_key(mesh),
+        paged_kernel, layer_scan, _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_verify_program(
             model.config, slots=slots, spec_len=spec_len, pmax=pmax,
             rope_len=rope_len, pad_id=pad_id, mesh=mesh,
-            paged_kernel=paged_kernel,
+            paged_kernel=paged_kernel, layer_scan=layer_scan,
         ),
     )
 
@@ -415,6 +443,7 @@ def _build_verify_program(
     pad_id: int,
     mesh,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ):
     """The speculative-decoding verification program: ONE jitted,
     pool/logits-donating dispatch that scores every slot's
@@ -473,7 +502,7 @@ def _build_verify_program(
             all_logits, ks, vs = verify_tokens_paged(
                 model, cand, pooled_len, pool.k, pool.v, bt, rope_len,
                 pool_sk=pool.scale_k, pool_sv=pool.scale_v,
-                paged_kernel=paged_kernel,
+                paged_kernel=paged_kernel, layer_scan=layer_scan,
             )  # all_logits: [S, T, V]; ks/vs: [L, S, Hkv, T, C]
             preds = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
             # draft row j (cand[:, j], j >= 1) matches iff it equals the
@@ -544,6 +573,7 @@ def trace_serving_programs(
     mesh=None,
     kv_quant: tp.Optional[str] = None,
     paged_kernel: str = "xla",
+    layer_scan: str = "off",
 ) -> tp.Dict[str, tp.Any]:
     """Abstractly trace the engine's three hot-path programs to jaxprs —
     the input of the arithmetic-choreography prover
@@ -575,6 +605,7 @@ def trace_serving_programs(
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
         rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
+        layer_scan=layer_scan,
     )
     decode_jaxpr = jax.make_jaxpr(window_fn)(
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
@@ -583,7 +614,7 @@ def trace_serving_programs(
     )
     chunk_fn = make_prefill_chunk_program(
         model, chunk_len=chunk_len, pmax=pmax, rope_len=cfg.block_size,
-        mesh=mesh,
+        mesh=mesh, layer_scan=layer_scan,
     )
     chunk_jaxpr = jax.make_jaxpr(chunk_fn)(
         model, pool, logits, i32(), i32(1, chunk_len), i32(), i32(),
@@ -592,6 +623,7 @@ def trace_serving_programs(
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
         rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
+        layer_scan=layer_scan,
     )
     verify_jaxpr = jax.make_jaxpr(verify_fn)(
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
@@ -742,6 +774,7 @@ class ServingEngine:
         quant: tp.Optional[str] = None,
         kv_quant: tp.Optional[str] = None,
         paged_kernel: str = "auto",
+        layer_scan: str = "off",
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
         max_queue: tp.Optional[int] = None,
@@ -787,6 +820,15 @@ class ServingEngine:
         # VMEM, xla otherwise (same dispatch philosophy as
         # ops/attention's flash-vs-naive)
         assert paged_kernel in ("auto", "pallas", "xla"), paged_kernel
+        # fused layer loop (ROADMAP item 1): "on" folds every program's
+        # per-layer loop into one lax.scan (models.gpt layer_scan=) —
+        # bitwise the unrolled program (token-identity matrix), gated
+        # statically by the analysis.fusion scan-equivalence prover and
+        # the analysis.dispatch launch budgets. Default "off" until the
+        # r6 hardware rungs measure the dispatch-overhead win (the
+        # bench ladder runs both).
+        assert layer_scan in ("on", "off"), layer_scan
+        self.layer_scan = layer_scan
         # quantized weight path (midgpt_tpu.quant): quant="int8" converts
         # the model to the int8 per-channel serving pytree here, so every
         # program this engine compiles (decode window, prefill chunk,
@@ -986,6 +1028,7 @@ class ServingEngine:
                 pad_id=pad_id,
                 mesh=mesh,
                 paged_kernel=self.paged_kernel,
+                layer_scan=self.layer_scan,
             )
             self._window_fn = None
         else:
@@ -1001,6 +1044,7 @@ class ServingEngine:
                 top_k=top_k,
                 mesh=mesh,
                 paged_kernel=self.paged_kernel,
+                layer_scan=self.layer_scan,
             )
         self._chunk_fns: tp.Dict[int, tp.Any] = {}
         self._copy_fn = make_copy_page_program()
@@ -1300,6 +1344,7 @@ class ServingEngine:
                 pmax=self.pmax,
                 rope_len=self.block,
                 mesh=self._mesh,
+                layer_scan=self.layer_scan,
             )
         self.pool, self.logits = self._chunk_fns[bucket](
             self.model,
@@ -1704,6 +1749,7 @@ class ServingEngine:
                     pmax=self.pmax,
                     rope_len=self.block,
                     mesh=self._mesh,
+                    layer_scan=self.layer_scan,
                 )
             self.pool, self.logits = self._chunk_fns[b](
                 self.model,

@@ -129,6 +129,15 @@ def main() -> None:
                     "once, no gathered [S, Pmax*PS, ...] intermediate), "
                     "'xla' keeps the gather path, 'auto' = pallas on "
                     "TPU when the VMEM assembly fits")
+    ap.add_argument("--layer_scan", choices=("on", "off"), default="off",
+                    help="fold each program's per-layer loop into one "
+                    "lax.scan (models.gpt layer_scan, ROADMAP item 1): "
+                    "one inlined layer body per program instead of L, "
+                    "shrinking the per-dispatch launch structure the "
+                    "decode residual over the HBM floor is made of — "
+                    "bitwise the unrolled program (gated by the "
+                    "analysis.fusion prover + dispatch budgets); run "
+                    "on/off on the same trace to ladder the win")
     ap.add_argument("--quant", choices=("on", "off"), default="off",
                     help="serve the int8 per-channel quantized weight "
                     "path (midgpt_tpu.quant): dequant fused into each "
@@ -196,7 +205,7 @@ def main() -> None:
         f"spec={args.spec_len if args.spec == 'on' else 'off'}"
         f"{' rep' if args.repetitive else ''}"
         f" quant={args.quant} kv_quant={args.kv_quant}"
-        f" kernel={args.paged_kernel}"
+        f" kernel={args.paged_kernel} ls={args.layer_scan}"
         f" tp={args.tp} dp={args.dp_replicas}"
         f"{' faults=' + args.fault_plan if args.fault_plan else ''}"
     )
@@ -314,6 +323,7 @@ def main() -> None:
         speculate=args.spec_len if args.spec == "on" else 0,
         kv_quant="int8" if args.kv_quant == "on" else None,
         paged_kernel=args.paged_kernel,
+        layer_scan=args.layer_scan,
     )
     meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
     # fault injection and the dispatch watchdog live in the cluster's
@@ -449,6 +459,36 @@ def main() -> None:
             print(f"comms summary skipped: {e}", file=sys.stderr)
             comms_bytes = None
 
+    # static dispatch/launch structure of THIS trace's decode program
+    # (analysis.dispatch — the launch-side twin of the byte
+    # decomposition below): trace the engine's own decode/verify
+    # program geometry and record launches-per-window, the folded
+    # layer-scan trip, inlined layer bodies and host transfers next to
+    # the measured tok/s, so the fused-vs-unfused r6 rungs carry their
+    # static structure in-band. Best-effort like the comms summary —
+    # tracing only, after the timed region.
+    disp = {}
+    try:
+        from midgpt_tpu.analysis.dispatch import dispatch_report
+        from midgpt_tpu.serving.engine import trace_serving_programs
+
+        jaxprs = trace_serving_programs(
+            engines[0].model, slots=args.slots, window=args.window,
+            spec_len=max(1, args.spec_len if args.spec == "on" else 1),
+            page_size=args.page_size,
+            kv_quant="int8" if args.kv_quant == "on" else None,
+            paged_kernel=engines[0].paged_kernel,
+            layer_scan=args.layer_scan,
+        )
+        key = "verify" if args.spec == "on" else "decode_window"
+        rep = dispatch_report(
+            jaxprs[key], program=key,
+            window_steps=1 if args.spec == "on" else args.window,
+        )
+        disp = rep.to_dict()
+    except Exception as e:  # noqa: BLE001 — summary is best-effort
+        print(f"dispatch summary skipped: {e}", file=sys.stderr)
+
     # static HBM decomposition for THIS trace's geometry (analysis/
     # traffic.py — the same arithmetic that generates PERF.md's floor
     # table): weight + live-KV + logits streams per decode step at the
@@ -501,6 +541,13 @@ def main() -> None:
         "serve_quant": args.quant,
         "serve_kv_quant": args.kv_quant,
         "serve_paged_kernel": engines[0].paged_kernel,
+        "serve_layer_scan": args.layer_scan,
+        "serve_static_launches_per_window": disp.get("launches_per_window"),
+        "serve_static_inlined_layer_bodies": disp.get(
+            "inlined_layer_bodies"
+        ),
+        "serve_static_layer_scan_length": disp.get("layer_scan_length"),
+        "serve_static_host_transfers": disp.get("host_transfers"),
         "serve_peak_hbm_bytes": peak_hbm,
         "serve_bytes_per_token_static": static["bytes_per_token"],
         "serve_bytes_per_step_static": static["bytes_per_step"],

@@ -129,6 +129,27 @@ run serving_kernel_off_kvq_on python scripts/bench_serving.py \
 run serving_kernel_on_kvq_on python scripts/bench_serving.py \
   --platform=tpu --quant on --paged_kernel pallas --kv_quant on \
   --out artifacts/bench_serving_kernel_on_kvq_on.json
+# NEW in PR 11: the fused-layer-scan rung pair (ROADMAP item 1's
+# success metric, measured directly): fused vs unfolded decode at the
+# production precision (int8 weights + int8 KV), single chip and tp=2.
+# The fold is BITWISE the unrolled program (analysis.fusion prover +
+# token-identity matrix); the delta between each pair is pure per-layer
+# launch overhead — the residual PERF.md's decomposition puts between
+# r5's 0.905 ms/tok and the 0.278/0.139 ms HBM floors. Each record
+# carries its static structure in-band (serve_static_launches_per_window
+# / serve_static_inlined_layer_bodies / serve_static_layer_scan_length).
+run serving_fuse_off_tp1 python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on --layer_scan off \
+  --out artifacts/bench_serving_fuse_off_tp1.json
+run serving_fuse_on_tp1 python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on --layer_scan on \
+  --out artifacts/bench_serving_fuse_on_tp1.json
+run serving_fuse_off_tp2 python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on --layer_scan off --tp 2 \
+  --out artifacts/bench_serving_fuse_off_tp2.json
+run serving_fuse_on_tp2 python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on --layer_scan on --tp 2 \
+  --out artifacts/bench_serving_fuse_on_tp2.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -95,6 +95,7 @@ def pytest_collection_modifyitems(config, items):
 # ---------------------------------------------------------------------------
 
 _CALL_DURATIONS: list = []
+_DESELECTED_SLOW: dict = {}
 
 
 def pytest_runtest_logreport(report):
@@ -102,7 +103,29 @@ def pytest_runtest_logreport(report):
         _CALL_DURATIONS.append((report.duration, report.nodeid))
 
 
+def pytest_deselected(items):
+    # tally slow-tier tests that were collected but deselected (the
+    # `-m 'not slow'` tier-1 runs), per file — so the tier split of a
+    # new test family is visible in every CI log instead of only in an
+    # explicit `-m slow` collection
+    for item in items:
+        if item.get_closest_marker("slow") is not None:
+            key = item.nodeid.split("::", 1)[0]
+            _DESELECTED_SLOW[key] = _DESELECTED_SLOW.get(key, 0) + 1
+
+
 def pytest_terminal_summary(terminalreporter):
+    if _DESELECTED_SLOW:
+        total_slow = sum(_DESELECTED_SLOW.values())
+        terminalreporter.write_sep(
+            "-",
+            f"slow tier: {total_slow} collected-but-skipped test(s) "
+            "this session (run with -m slow / in their CI jobs)",
+        )
+        for path in sorted(_DESELECTED_SLOW):
+            terminalreporter.write_line(
+                f"{_DESELECTED_SLOW[path]:4d}  {path}"
+            )
     if not _CALL_DURATIONS:
         return
     top = sorted(_CALL_DURATIONS, reverse=True)[:10]

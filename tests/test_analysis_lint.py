@@ -401,6 +401,93 @@ def test_pragma_on_other_line_does_not_waive():
 
 
 # ---------------------------------------------------------------------------
+# no-unrolled-layer-loop (serving modules only)
+# ---------------------------------------------------------------------------
+
+
+_LAYER_LOOP_SRC = """
+    import jax
+
+    def build(cfg):
+        def window_fn(model, pool):
+            h = pool
+            for i in range(cfg.n_layer):
+                h = model.block(h, i)
+            return h
+
+        return jax.jit(window_fn, donate_argnums=(1,))
+    """
+
+
+def test_unrolled_layer_loop_in_serving_flagged():
+    fs = _lint_serving(_LAYER_LOOP_SRC)
+    assert ("no-unrolled-layer-loop", 7) in _rules(fs)
+
+
+def test_unrolled_layer_loop_outside_serving_not_flagged():
+    """Scoped to midgpt_tpu/serving/: the models/ drivers keep their
+    unrolled layer_scan="off" branch on purpose (it is the fold's
+    bitwise reference, selected by the engine knob)."""
+    fs = lint_source(
+        textwrap.dedent(_LAYER_LOOP_SRC), path="midgpt_tpu/models/probe.py"
+    )
+    assert [
+        (r, n) for r, n in _rules(fs) if r == "no-unrolled-layer-loop"
+    ] == []
+
+
+def test_unrolled_layer_loop_untraced_not_flagged():
+    """A host-side loop over layers (checkpoint surgery, stats) is not
+    a jitted program body — only traced roots are in scope."""
+    fs = _lint_serving(
+        """
+        def describe(cfg, params):
+            out = []
+            for i in range(cfg.n_layer):
+                out.append(params[i].shape)
+            return out
+        """
+    )
+    assert _rules(fs) == []
+
+
+def test_unrolled_layer_loop_waivable():
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build(cfg):
+            def window_fn(model, pool):
+                h = pool
+                for i in range(cfg.n_layer):  # shardlint: disable=no-unrolled-layer-loop
+                    h = model.block(h, i)
+                return h
+
+            return jax.jit(window_fn, donate_argnums=(1,))
+        """
+    )
+    assert _rules(fs) == []  # _rules filters to unwaived findings
+
+
+def test_non_layer_loop_in_serving_not_flagged():
+    fs = _lint_serving(
+        """
+        import jax
+
+        def build(cfg):
+            def window_fn(model, pool):
+                h = pool
+                for i in range(4):
+                    h = h + model.step(h)
+                return h
+
+            return jax.jit(window_fn, donate_argnums=(1,))
+        """
+    )
+    assert _rules(fs) == []
+
+
+# ---------------------------------------------------------------------------
 # the shipped tree stays clean
 # ---------------------------------------------------------------------------
 

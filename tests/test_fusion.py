@@ -1,0 +1,252 @@
+"""Scan-equivalence prover + dispatch/launch auditor tests
+(analysis/fusion.py, analysis/dispatch.py — the sixth audit family).
+
+The prover must (a) PASS on the shipped tree — the unrolled serving
+programs are layer-homogeneous and the fused (``layer_scan="on"``) scan
+bodies are op-for-op the per-layer traces — and (b) FAIL on injected
+faults, mirroring test_choreo.py's re-injection style:
+
+- a deliberately layer-HETEROGENEOUS model (one layer's arithmetic
+  differs) must fail the homogeneity check — the precondition that
+  makes the fold legal at all;
+- a re-unrolled program must fail the "on" dispatch budget (zero byte
+  movement, so only the launch structure sees it);
+- a dtype drift that exists ONLY on the scan path (the class of bug a
+  fused rewrite can introduce while the unrolled path stays green) must
+  fail the scan-body ≡ per-layer trace equality.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from midgpt_tpu.analysis.budgets import (
+    DISPATCH_BUDGETS,
+    check_dispatch_budget,
+    dispatch_budget_for,
+)
+from midgpt_tpu.analysis.fusion import layer_segments
+from midgpt_tpu.analysis.harness import (
+    audit_serving_dispatch,
+    prove_scan_equivalence,
+    serving_dispatch_reports,
+)
+from midgpt_tpu.models.gpt import Attention
+from midgpt_tpu.serving import engine as engine_mod
+
+
+@pytest.fixture(scope="module")
+def healthy_report():
+    return prove_scan_equivalence("openwebtext")
+
+
+def _checks(report):
+    return {c.name: c.ok for c in report.checks}
+
+
+# ---------------------------------------------------------------------------
+# the prover passes on the shipped tree
+# ---------------------------------------------------------------------------
+
+
+def test_prover_passes_on_current_tree(healthy_report):
+    assert healthy_report.ok, "\n".join(
+        f"{c.name}: {c.detail}"
+        for c in healthy_report.checks
+        if not c.ok
+    )
+    # every program contributes its full check set
+    names = [c.name for c in healthy_report.checks]
+    for prog in ("decode_window", "prefill_chunk", "verify"):
+        assert any(n.startswith(prog) for n in names), prog
+
+
+def test_prover_passes_on_quant_kv_kernel_cell():
+    """The far corner of the cell matrix (int8 weights + int8 KV +
+    Pallas kernel traces); the full 8-cell grid runs in the CI
+    serving-choreo job via ``--fusion --precision both --kv-quant
+    both``."""
+    rep = prove_scan_equivalence(
+        "openwebtext", quant=True, kv_quant=True, paged_kernel="pallas"
+    )
+    assert rep.ok, "\n".join(
+        f"{c.name}: {c.detail}" for c in rep.checks if not c.ok
+    )
+
+
+def test_layer_segments_unit():
+    proj = ("proj", ("bfloat16", "bfloat16"), ("float32",))
+    a = ("add", ("float32", "float32"), ("float32",))
+    m = ("mul", ("float32", "float32"), ("float32",))
+    # 2 layers x 2 projs each + 1 head proj; identical layer bodies
+    trace = [proj, a, proj, m, proj, a, proj, m, proj, a]
+    segs = layer_segments(trace, 2)
+    assert segs is not None and len(segs) == 2
+    assert segs[0] == segs[1] == (proj, a, proj, m)
+    # head/tail records outside the boundaries are excluded
+    assert layer_segments([a] + trace, 2) == segs
+    # non-dividing proj structure -> None (a failed check, never vacuous)
+    assert layer_segments(trace[:-1] + [proj], 2) is None
+    assert layer_segments([], 2) is None
+
+
+# ---------------------------------------------------------------------------
+# dispatch/launch auditor + budgets
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dispatch_on():
+    return serving_dispatch_reports("openwebtext", layer_scan="on")
+
+
+@pytest.fixture(scope="module")
+def dispatch_off():
+    return serving_dispatch_reports("openwebtext", layer_scan="off")
+
+
+def test_dispatch_budgets_pass_both_ways(dispatch_on, dispatch_off):
+    for ls, reports in (("on", dispatch_on), ("off", dispatch_off)):
+        for name, rep in reports.items():
+            budget = dispatch_budget_for(name, ls)
+            assert budget is not None, (name, ls)
+            assert not check_dispatch_budget(rep, budget), (name, ls)
+
+
+def test_fused_decode_window_structure(dispatch_on):
+    """The fused decode window: ONE launch per K-token window, the
+    layer loop as a scan of trip n_layer NESTED inside the window scan,
+    one inlined layer body, zero host transfers."""
+    rep = dispatch_on["decode_window"]
+    assert rep.launches_per_window == 1
+    assert rep.inlined_layer_bodies == 1
+    assert rep.layer_scan_length == 2  # audit shrink depth
+    assert rep.host_transfers == 0
+    depths = {s.depth for s in rep.scans}
+    assert depths == {0, 1}  # window scan at 0, layer scan inside
+    layer = [s for s in rep.scans if s.is_layer_scan]
+    assert len(layer) == 1 and layer[0].depth == 1
+
+
+def test_unrolled_trace_fails_the_fused_budget(dispatch_off):
+    """Re-unrolling the layer loop moves ZERO bytes (the byte budgets
+    stay green) but flips the launch structure — the 'on' budget cells
+    must catch exactly that."""
+    for name, rep in dispatch_off.items():
+        assert rep.layer_scan_length == 0
+        bad = check_dispatch_budget(rep, DISPATCH_BUDGETS[(name, "on")])
+        assert bad, name
+        assert any("inlined_layer_bodies" in v for v in bad), bad
+    # ... and a fused trace fails the 'off' cells symmetrically (a
+    # half-migrated audit can't silently pass the wrong leg)
+    fused = serving_dispatch_reports("openwebtext", layer_scan="on")
+    assert check_dispatch_budget(
+        fused["decode_window"], DISPATCH_BUDGETS[("decode_window", "off")]
+    )
+
+
+def test_dispatch_sees_callbacks_inside_cond_branches():
+    """The host-transfer gate must not be blind to sub-jaxprs stored in
+    TUPLE params: ``lax.cond``'s branches are a plain tuple of
+    ClosedJaxprs, which a bare hasattr walk over params.values() skips
+    — a callback hidden in a branch would pass the budget vacuously
+    (caught in code review)."""
+    from midgpt_tpu.analysis.dispatch import dispatch_report
+
+    def traced(x):
+        def branch(v):
+            jax.debug.callback(lambda a: None, v)
+            return v * 2.0
+
+        return jax.lax.cond(x[0] > 0, branch, lambda v: v, x)
+
+    cj = jax.make_jaxpr(traced)(jnp.zeros((2,), jnp.float32))
+    rep = dispatch_report(cj, program="probe")
+    assert rep.host_transfers >= 1
+
+
+def test_audit_serving_dispatch_end_to_end():
+    reports, violations = audit_serving_dispatch(
+        "openwebtext", layer_scan="on"
+    )
+    assert set(reports) == {
+        "decode_window", "prefill_chunk", "verify_program"
+    }
+    assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# fault injection: a layer-heterogeneous model must fail homogeneity
+# ---------------------------------------------------------------------------
+
+
+def test_prover_catches_layer_heterogeneity(monkeypatch):
+    """A model whose layers do NOT share one arithmetic (here: layer 1
+    — the middle layer of the depth-3 trace — runs its attention output
+    through an f32 round-trip) is not legally foldable; the homogeneity
+    check must fail. The fault is injected the way a real regression
+    would arrive: a depth-dependent special case inside the per-layer
+    attention method."""
+    orig = Attention.decode_paged_at
+
+    def hetero(self, x, pool_k, pool_v, bt, rk, rv, layer, r, *a, **kw):
+        out, rk, rv = orig(
+            self, x, pool_k, pool_v, bt, rk, rv, layer, r, *a, **kw
+        )
+        if isinstance(layer, int) and layer == 1:
+            out = out.astype(jnp.float32).astype(out.dtype)
+        return out, rk, rv
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(Attention, "decode_paged_at", hetero)
+    try:
+        rep = prove_scan_equivalence("openwebtext")
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks[
+        "decode_window: unrolled layers are homogeneous (full trace)"
+    ] is False
+    # the other two programs (their loops untouched) stay green
+    assert checks[
+        "prefill_chunk: unrolled layers are homogeneous (full trace)"
+    ] is True
+
+
+# ---------------------------------------------------------------------------
+# fault injection: a scan-body-only dtype drift must fail trace equality
+# ---------------------------------------------------------------------------
+
+
+def test_prover_catches_scan_body_drift(monkeypatch):
+    """A dtype drift that exists ONLY on the fused path — the scan body
+    upcasts its input through f32 while the unrolled path stays exactly
+    as shipped. The scan branch calls the same per-layer method on a
+    [1, ...] per-layer pool view (the unrolled branch passes the full
+    [L, ...] pool), which is where a fused-path-only regression would
+    live; the scan-body ≡ per-layer equality must turn red."""
+    orig = Attention.decode_paged_at
+
+    def drifted(self, x, pool_k, pool_v, *a, **kw):
+        out, rk, rv = orig(self, x, pool_k, pool_v, *a, **kw)
+        if pool_k.shape[0] == 1:  # the scan body's per-layer view
+            out = out.astype(jnp.float32).astype(out.dtype)
+        return out, rk, rv
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(Attention, "decode_paged_at", drifted)
+    try:
+        rep = prove_scan_equivalence("openwebtext")
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks[
+        "decode_window: scan body equals the per-layer trace "
+        "(full segment)"
+    ] is False
+    # the unrolled trace is untouched: homogeneity stays green
+    assert checks[
+        "decode_window: unrolled layers are homogeneous (full trace)"
+    ] is True

@@ -496,6 +496,51 @@ def test_prefix_cache_and_chunking_token_identity(shared_prefix_case):
         np.testing.assert_array_equal(np.asarray(r), refs[i], err_msg=f"req {i}")
 
 
+def _run_layer_scan(model, prompts, lens, ls, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    eng = ServingEngine(
+        model, slots=2, page_size=8, window=4, temperature=0.0,
+        layer_scan=ls, **kw,
+    )
+    rids = [eng.submit(p, n) for p, n in zip(prompts, lens)]
+    fin = eng.run()
+    return [fin[r].tokens for r in rids]
+
+
+def test_layer_scan_token_identity(shared_prefix_case):
+    """Landing gate of the fused layer loop (ROADMAP item 1): greedy
+    streams with ``layer_scan="on"`` are bit-identical to the unrolled
+    engine AND to the exact fixed-batch sampler — mid-run admission,
+    shared prefixes, speculation. The chunked / kv-quant / cache-off
+    legs ride the slow tier below; tp=2/4 lives in
+    test_serving_sharded.py."""
+    model, prompts, lens, refs = shared_prefix_case
+    for kw in (dict(), dict(speculate=3)):
+        on = _run_layer_scan(model, prompts, lens, "on", **kw)
+        off = _run_layer_scan(model, prompts, lens, "off", **kw)
+        assert on == off, kw
+    for i, r in enumerate(on):  # spec-on fused vs the exact sampler
+        np.testing.assert_array_equal(np.asarray(r), refs[i])
+
+
+@pytest.mark.slow
+def test_layer_scan_token_identity_matrix_slow(shared_prefix_case):
+    """The remaining single-chip layer_scan cells: chunked prefill,
+    prefix-cache off, and the int8 KV pool (each a fresh fused-program
+    compile)."""
+    model, prompts, lens, _ = shared_prefix_case
+    for kw in (
+        dict(prefill_chunk=8),
+        dict(prefix_cache=False),
+        dict(kv_quant="int8", cache_dtype=jnp.bfloat16),
+        dict(kv_quant="int8", cache_dtype=jnp.bfloat16, speculate=3,
+             prefill_chunk=5),
+    ):
+        on = _run_layer_scan(model, prompts, lens, "on", **kw)
+        off = _run_layer_scan(model, prompts, lens, "off", **kw)
+        assert on == off, kw
+
+
 def test_shared_prefix_skips_prefill_compute():
     """Acceptance: a two-request shared-prefix scenario demonstrably
     skips the shared pages' prefill — the second request computes only
