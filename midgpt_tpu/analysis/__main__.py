@@ -181,6 +181,18 @@ def build_parser() -> argparse.ArgumentParser:
         "standalone (like --choreo) or inside --serving.",
     )
     p.add_argument(
+        "--telemetry", choices=("off", "on"), default="off",
+        help="with --serving (or standalone): run the telemetry-"
+        "inertness proof (analysis.harness.prove_telemetry_inert) — "
+        "two engines differing only in telemetry= must resolve to the "
+        "IDENTICAL cached jitted callables (telemetry is not a program-"
+        "factory parameter, so donation/no-host-sync/traffic/dispatch "
+        "results proven for the untraced programs apply verbatim with "
+        "tracing on) and produce bitwise-equal greedy streams, with "
+        "events actually recorded. Runs on a fixed tiny model in "
+        "seconds, decode-window and verify paths both.",
+    )
+    p.add_argument(
         "--mesh-shape", default=None, metavar="SPEC",
         help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
         "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
@@ -363,6 +375,29 @@ def _run_choreo_only(args, cfg) -> int:
     return _emit_report(out, ok, violations, args)
 
 
+def _run_telemetry() -> tp.Tuple[tp.Dict[str, tp.Any], bool, tp.List[str]]:
+    """The telemetry-inertness proof (--telemetry on): both dispatch
+    shapes — the fused decode window (with chunked prefill, so the
+    prefill-bucket programs are covered too) and the speculative verify
+    program. Tiny fixed model, seconds, no compilation of the named
+    config (the proof is an engine-logic property — see
+    harness.prove_telemetry_inert)."""
+    from midgpt_tpu.analysis.harness import prove_telemetry_inert
+
+    sections: tp.Dict[str, tp.Any] = {}
+    violations: tp.List[str] = []
+    for name, kw in (
+        ("decode_window_chunked", dict(prefill_chunk=4, speculate=0)),
+        ("verify_spec4", dict(prefill_chunk=None, speculate=4)),
+    ):
+        try:
+            sections[name] = prove_telemetry_inert(**kw)
+        except AssertionError as e:
+            sections[name] = {"ok": False, "error": str(e)}
+            violations.append(f"telemetry-inert/{name}: {e}")
+    return sections, not violations, violations
+
+
 def _run_serving(args, cfg, mesh_shape) -> int:
     """The --serving audits: compile the engine's three hot-path
     programs (decode window / prefill chunk / speculative verify) on
@@ -501,6 +536,11 @@ def _run_serving(args, cfg, mesh_shape) -> int:
         fusion_out, fusion_ok, fusion_violations = _run_fusion(args, cfg)
         ok = ok and fusion_ok
         violations.extend(fusion_violations)
+    telemetry_out = None
+    if args.telemetry == "on":
+        telemetry_out, tele_ok, tele_violations = _run_telemetry()
+        ok = ok and tele_ok
+        violations.extend(tele_violations)
 
     out = {
         "config": args.config,
@@ -522,6 +562,8 @@ def _run_serving(args, cfg, mesh_shape) -> int:
         out["choreography"] = choreo_out
     if fusion_out is not None:
         out["fusion"] = fusion_out
+    if telemetry_out is not None:
+        out["telemetry"] = telemetry_out
     text = json.dumps(out, indent=2)
     print(text)
     if args.json:
@@ -643,6 +685,15 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         # standalone scan-equivalence prover + dispatch budgets: also
         # tracing only — the serving-choreo CI job's sixth-family gate
         return _run_fusion_only(args, cfg)
+    if args.telemetry == "on":
+        # standalone telemetry-inertness proof (tiny fixed model — the
+        # named config only labels the report)
+        sections, ok, viol = _run_telemetry()
+        out = {
+            "config": args.config, "mode": "telemetry-inertness",
+            "telemetry": sections, "ok": ok,
+        }
+        return _emit_report(out, ok, viol, args)
 
     overrides = dict(args.override_logical_rule) or None
     if overrides:

@@ -36,6 +36,17 @@ Public surface:
   :class:`~midgpt_tpu.serving.faults.PoolOverloaded`, the replica fault
   exceptions) — deterministic, scripted chaos injection keyed to
   scheduler-step boundaries, replayable bit for bit.
+- :class:`~midgpt_tpu.serving.telemetry.EngineTelemetry`,
+  :class:`~midgpt_tpu.serving.telemetry.MetricsRegistry`,
+  :func:`~midgpt_tpu.serving.telemetry.chrome_trace` — the observability
+  layer: per-request lifecycle tracing keyed to scheduler steps
+  (``ServingEngine(telemetry=True)``; zero program perturbation — the
+  traced engine launches the identical cached jitted callables and
+  greedy streams are bitwise identical either way), the registry behind
+  ``stats()`` (``ENGINE_STATS_KEYS``/``CLUSTER_STATS_KEYS`` pin the
+  façade's key contract), the fault flight recorder
+  (``ServingEngine.flight_dump``, ``ServingCluster(flight_dir=...)``),
+  and Perfetto-loadable timeline export.
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -67,6 +78,13 @@ from midgpt_tpu.serving.engine import (
     make_verify_program,
 )
 from midgpt_tpu.serving.speculate import NgramProposer, Proposer
+from midgpt_tpu.serving.telemetry import (
+    CLUSTER_STATS_KEYS,
+    ENGINE_STATS_KEYS,
+    EngineTelemetry,
+    MetricsRegistry,
+    chrome_trace,
+)
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
@@ -80,9 +98,13 @@ from midgpt_tpu.serving.paged import (
 
 __all__ = [
     "AdmissionRejected",
+    "CLUSTER_STATS_KEYS",
     "ClusterUnavailable",
+    "ENGINE_STATS_KEYS",
+    "EngineTelemetry",
     "FaultEvent",
     "FaultPlan",
+    "MetricsRegistry",
     "NgramProposer",
     "PageAllocator",
     "PagedKVPool",
@@ -96,6 +118,7 @@ __all__ = [
     "ServingFault",
     "TransientDispatchError",
     "WedgedDispatch",
+    "chrome_trace",
     "copy_page",
     "serving_meshes",
     "flush_recent",

@@ -62,12 +62,15 @@ lets that front door promise SLOs.
 from __future__ import annotations
 
 import concurrent.futures
+import os
+import sys
 import time
 import typing as tp
 
 import numpy as np
 
 from midgpt_tpu.serving.engine import Request, ServingEngine
+from midgpt_tpu.serving.telemetry import EngineTelemetry
 from midgpt_tpu.serving.faults import (
     AdmissionRejected,
     ClusterUnavailable,
@@ -163,6 +166,7 @@ class ServingCluster:
         max_retries: int = 3,
         backoff_s: float = 0.05,
         backoff_cap_s: float = 1.0,
+        flight_dir: tp.Optional[str] = None,
         **engine_kwargs,
     ):
         if meshes is None:
@@ -179,6 +183,24 @@ class ServingCluster:
         assert max_retries >= 0 and backoff_s >= 0.0, (
             max_retries, backoff_s,
         )
+        # telemetry rides through engine_kwargs: telemetry=True gives
+        # every replica its OWN EngineTelemetry (each engine constructs
+        # one); a shared instance across replicas would interleave
+        # event streams from concurrently-stepping threads, so it is
+        # rejected here
+        assert not (
+            isinstance(engine_kwargs.get("telemetry"), EngineTelemetry)
+            and len(meshes) > 1
+        ), (
+            "pass telemetry=True for a multi-replica cluster — each "
+            "replica needs its own EngineTelemetry instance"
+        )
+        # flight_dir: where dead-replica flight-recorder artifacts land
+        # (crash / watchdog trip / exhausted retries — every terminal
+        # path dumps; paths collected in self.flight_dumps). None
+        # disables the dumps.
+        self.flight_dir = flight_dir
+        self.flight_dumps: tp.List[str] = []
         self.engines: tp.List[ServingEngine] = []
         for i, m in enumerate(meshes):
             kw = dict(engine_kwargs)
@@ -334,6 +356,31 @@ class ServingCluster:
         self.health_reason[i] = reason
         if self.first_fault_time is None:
             self.first_fault_time = time.monotonic()
+        if self.flight_dir is not None:
+            self._flight_dump(i, reason)
+
+    def _flight_dump(self, i: int, reason: str) -> None:
+        """Persist replica ``i``'s flight recorder on the one choke
+        point every terminal failure crosses (crash, watchdog trip,
+        exhausted retries all land in ``_mark_dead``). Best-effort BY
+        DESIGN: on a watchdog trip the step thread may still be
+        appending to the rings (snapshot-copied under the GIL), and a
+        dump failure must never mask the failover it documents — it
+        degrades to a stderr line."""
+        path = os.path.join(
+            self.flight_dir, f"flight_replica{i}_{reason}.json"
+        )
+        try:
+            rec = self.engines[i].flight_dump(
+                reason, path=path, extra={"replica": i},
+            )
+            self.flight_dumps.append(rec["path"])
+        except Exception as e:  # noqa: BLE001 — see docstring
+            print(
+                f"flight-recorder dump for replica {i} ({reason}) "
+                f"failed: {e}",
+                file=sys.stderr,
+            )
 
     def _failover(self, i: int, cold: bool = False) -> None:
         """Fail dead replica ``i``'s backlog over to the survivors;
@@ -605,3 +652,31 @@ class ServingCluster:
         agg["replica_health_reason"] = list(self.health_reason)
         agg["per_replica"] = per
         return agg
+
+    @property
+    def telemetries(self) -> tp.List[tp.Optional[EngineTelemetry]]:
+        """The per-replica telemetry instances (None entries when
+        tracing is off) — bench_serving merges their derived request
+        metrics and writes one timeline artifact per replica."""
+        return [e.telemetry for e in self.engines]
+
+    def metrics_snapshot(self) -> tp.Dict[str, tp.Any]:
+        """Cluster-level registry export: the failover counters and
+        health state next to every replica's full
+        ``ServingEngine.metrics_snapshot()`` — the JSON artifact the r6
+        queue stores beside its bench rows. ``stats()`` remains the
+        stable façade (telemetry.CLUSTER_STATS_KEYS contract)."""
+        return {
+            "cluster": {
+                "dp_replicas": len(self.engines),
+                "watchdog_trips": self.watchdog_trips,
+                "retries": self.retries,
+                "failovers": self.failovers,
+                "requeued_requests": self.requeued_requests,
+                "dead_replicas": self.health.count("dead"),
+                "replica_health": list(self.health),
+                "replica_health_reason": list(self.health_reason),
+                "flight_dumps": list(self.flight_dumps),
+            },
+            "replicas": [e.metrics_snapshot() for e in self.engines],
+        }

@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import time
 import typing as tp
 
@@ -90,6 +91,11 @@ from midgpt_tpu.models.gpt import (
 )
 from midgpt_tpu.serving.faults import AdmissionRejected, PoolOverloaded
 from midgpt_tpu.serving.speculate import NgramProposer, Proposer
+from midgpt_tpu.serving.telemetry import (
+    EngineTelemetry,
+    MetricsRegistry,
+    write_json,
+)
 from midgpt_tpu.serving.paged import (
     PageAllocator,
     PagedKVPool,
@@ -696,6 +702,48 @@ class Request:
         return self.finish_time is not None
 
 
+# Registry-backed counter attributes of ServingEngine: every name here
+# becomes a class-level property reading/writing the engine's
+# MetricsRegistry Counter of the same name (attached right after the
+# class body). The registry is the single source of truth; stats() and
+# the metrics snapshot are two views of it.
+_ENGINE_COUNTERS = (
+    "decode_dispatches",
+    "prefill_dispatches",
+    "copy_dispatches",
+    "tokens_generated",
+    "windows",
+    "occupancy_sum",
+    "evictions",
+    "prompt_tokens_total",
+    "prompt_tokens_cached",
+    "prefill_tokens_computed",
+    "cold_reclaims",
+    "verify_dispatches",
+    "spec_drafted",
+    "spec_accepted",
+    "admission_rejected",
+    "shed_requests",
+    "deferred_submits",
+    "livelock_parks",
+    "overload_parks",
+    "faults_injected",
+)
+
+
+def _counter_property(name: str) -> property:
+    def _get(self):
+        return self.metrics.counter(name).value
+
+    def _set(self, v):
+        self.metrics.counter(name).value = v
+
+    return property(
+        _get, _set, doc=f"registry-backed counter {name!r} "
+        "(serving.telemetry.MetricsRegistry)"
+    )
+
+
 class ServingEngine:
     """Continuous-batching scheduler over ``slots`` decode lanes.
 
@@ -781,8 +829,32 @@ class ServingEngine:
         overload_policy: str = "defer",
         park_threshold: int = 2,
         fault_hook: tp.Optional[tp.Callable[["ServingEngine"], None]] = None,
+        telemetry: tp.Union[None, bool, EngineTelemetry] = None,
     ):
         assert slots >= 1 and window >= 1 and page_size >= 1
+        # observability (serving.telemetry): the metrics registry is
+        # ALWAYS on — the counter attributes below are properties over
+        # it, so stats() is a façade over one source of truth — while
+        # per-request lifecycle TRACING is opt-in (telemetry=True or an
+        # EngineTelemetry instance). Tracing is deliberately NOT a
+        # parameter of any program factory: an engine with tracing on
+        # launches the identical cached jitted callables (proven by
+        # analysis.harness.prove_telemetry_inert), every emission reads
+        # host-side scheduler state only, and when disabled each site
+        # costs one `is None` check — greedy streams are bitwise
+        # identical either way (tests/test_telemetry.py).
+        self.metrics = MetricsRegistry()
+        if telemetry is True:
+            telemetry = EngineTelemetry()
+        elif not telemetry:
+            # False and None both mean "tracing off" (bench_serving
+            # passes the computed bool straight through)
+            telemetry = None
+        assert telemetry is None or isinstance(telemetry, EngineTelemetry), (
+            f"telemetry must be None, a bool, or an EngineTelemetry, "
+            f"got {telemetry!r}"
+        )
+        self.telemetry = telemetry
         # overload degradation knobs: max_queue bounds the wait queue
         # (None = unbounded, the library default); a submit hitting the
         # bound is SHED (AdmissionRejected, the request is dropped for
@@ -1049,29 +1121,43 @@ class ServingEngine:
         self._chunk_fns: tp.Dict[int, tp.Any] = {}
         self._copy_fn = make_copy_page_program()
 
-        # counters (bench_serving / tests)
-        self.decode_dispatches = 0
-        self.prefill_dispatches = 0
-        self.copy_dispatches = 0
-        self.tokens_generated = 0
-        self.windows = 0
-        self.occupancy_sum = 0
-        self.evictions = 0
-        self.prompt_tokens_total = 0
-        self.prompt_tokens_cached = 0
-        self.prefill_tokens_computed = 0
-        self.cold_reclaims = 0
-        self.verify_dispatches = 0
-        self.spec_drafted = 0
-        self.spec_accepted = 0
-        # fault-tolerance / overload counters (stats())
-        self.admission_rejected = 0
+        # counters (bench_serving / tests): each name in
+        # _ENGINE_COUNTERS is a class-level property over self.metrics
+        # (serving.telemetry.MetricsRegistry), so `+= 1` here, the
+        # bench's warmup `setattr(e, name, 0)` reset, and the metrics
+        # snapshot all hit the SAME Counter objects — stats() keeps its
+        # exact key inventory (telemetry.ENGINE_STATS_KEYS, pinned by
+        # test) as a façade over the registry
+        for _n in _ENGINE_COUNTERS:
+            self.metrics.counter(_n)
         self.reject_reasons: tp.Dict[str, int] = {}
-        self.shed_requests = 0
-        self.deferred_submits = 0
-        self.livelock_parks = 0
-        self.overload_parks = 0
-        self.faults_injected = 0
+        self.metrics.attach_labels("reject_reasons", self.reject_reasons)
+        # live-state gauges, evaluated lazily at snapshot time (no
+        # mirrored writes on the scheduler hot path)
+        g = self.metrics.gauge
+        g("free_pages", lambda: self.alloc.free_pages)
+        g("cached_pages", lambda: self.alloc.cached_pages)
+        g("pool_utilization",
+          lambda: 1.0 - self.alloc.free_pages / max(1, self.alloc.num_pages))
+        g("queue_depth", lambda: len(self.queue))
+        g("parked_requests", lambda: len(self.parked))
+        g("active_slots", lambda: len(self._active_slots()))
+        g("slot_occupancy",
+          lambda: self.occupancy_sum / max(1, self.windows * self.slots))
+        g("prefix_hit_rate",
+          lambda: self.prompt_tokens_cached
+          / max(1, self.prompt_tokens_total))
+        g("spec_acceptance_rate",
+          lambda: self.spec_accepted / max(1, self.spec_drafted))
+        g("tokens_per_dispatch",
+          lambda: self.tokens_generated / max(1, self.decode_dispatches))
+        # fixed-bucket latency histograms: queue_delay/ttft/e2e observe
+        # from the scheduler's own clock reads (always on — no device
+        # access); tbt/dispatch need token timestamps, so they populate
+        # only under tracing
+        for _h in ("queue_delay_s", "ttft_s", "e2e_s", "tbt_s",
+                   "dispatch_s"):
+            self.metrics.histogram(_h)
 
     # -- submission ---------------------------------------------------------
 
@@ -1126,19 +1212,27 @@ class ServingEngine:
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             if self.overload_policy == "shed":
                 self.shed_requests += 1
+                self._emit("shed", reason="queue_full")
                 self._reject(
                     "queue_full",
                     f"wait queue at max_queue={self.max_queue}; shed",
                 )
             self.deferred_submits += 1
+            self._emit("deferred", reason="queue_full")
             raise PoolOverloaded(
                 "queue_full",
                 f"wait queue at max_queue={self.max_queue}; retry later",
             )
-        return self.resubmit(
-            self.make_request(prompt, max_new_tokens, eos_id=eos_id,
-                              seed=seed)
+        req = self.make_request(
+            prompt, max_new_tokens, eos_id=eos_id, seed=seed
         )
+        # the rid resubmit is about to assign — emitted here so the
+        # lifecycle reads submit -> queued in order
+        self._emit(
+            "submit", rid=self._next_rid, t=req.submit_time,
+            prompt_tokens=int(req.prompt.size), budget=int(max_new_tokens),
+        )
+        return self.resubmit(req)
 
     def make_request(
         self,
@@ -1177,6 +1271,10 @@ class ServingEngine:
         self._next_rid += 1
         req.rid = rid
         self.queue.append(req)
+        self._emit(
+            "queued", rid=rid, prompt_tokens=int(req.prompt.size),
+            tokens_emitted=len(req.tokens),
+        )
         return rid
 
     def drain_requests(self) -> tp.List[Request]:
@@ -1196,6 +1294,7 @@ class ServingEngine:
             req.prompt = np.concatenate(
                 [req.prompt0, np.asarray(req.tokens, np.int32)]
             )
+            self._emit("evicted", rid=req.rid, slot=s, drained=True)
             self._release_slot(s)
             out.append(req)
         out.extend(self.queue)
@@ -1205,6 +1304,19 @@ class ServingEngine:
         return out
 
     # -- internals ----------------------------------------------------------
+
+    def _emit(self, kind: str, rid=None, t=None, **data) -> None:
+        """One lifecycle event into the attached telemetry — a no-op
+        `is None` check when tracing is off (the clock is not even
+        read). Data fields must be deterministic under replay; wall
+        clock rides only in ``t`` (serving.telemetry.Event)."""
+        tele = self.telemetry
+        if tele is None:
+            return
+        tele.emit(
+            kind, step=self.fault_step,
+            t=self.clock() if t is None else t, rid=rid, **data,
+        )
 
     def _active_slots(self) -> tp.List[int]:
         return [s for s in range(self.slots) if self.slot_req[s] is not None]
@@ -1316,6 +1428,18 @@ class ServingEngine:
             self.prompt_tokens_cached += matched
             req.cached_tokens += matched
             req.admit_tokens = len(req.tokens)  # livelock-guard baseline
+            now = self.clock()
+            if not req.tokens and req.evictions == 0:
+                # first admission of a fresh request: the wait it just
+                # paid IS the queue delay (re-admissions are eviction
+                # stall, tracked by telemetry's derived metrics)
+                self.metrics.histogram("queue_delay_s").observe(
+                    now - req.submit_time
+                )
+            self._emit(
+                "admitted", rid=req.rid, t=now, slot=s, prompt_tokens=p,
+                cached_tokens=matched, pages=n_pages,
+            )
             admitted += 1
 
     # -- chunked prefill ----------------------------------------------------
@@ -1346,6 +1470,8 @@ class ServingEngine:
                 mesh=self._mesh,
                 layer_scan=self.layer_scan,
             )
+        tele = self.telemetry
+        t0 = self.clock() if tele is not None else 0.0
         self.pool, self.logits = self._chunk_fns[bucket](
             self.model,
             self.pool,
@@ -1358,6 +1484,17 @@ class ServingEngine:
         )
         self.prefill_dispatches += 1
         self.prefill_tokens_computed += clen
+        if tele is not None:
+            t1 = self.clock()
+            tele.record_dispatch(
+                "prefill_chunk", step=self.fault_step, t=t0, dur=t1 - t0,
+                rids=(req.rid,), tokens=0, slot=s, start=start,
+                chunk=clen, bucket=bucket,
+            )
+            tele.emit(
+                "prefill_chunk", step=self.fault_step, t=t1, rid=req.rid,
+                slot=s, start=start, chunk=clen, bucket=bucket,
+            )
         self.pooled_len[s] = start + clen
         self._register_pages(s)
         if start + clen >= p:
@@ -1470,14 +1607,20 @@ class ServingEngine:
             [req.prompt0, np.asarray(req.tokens, np.int32)]
         )
         req.evictions += 1
+        self._emit(
+            "evicted", rid=req.rid, slot=s, progressed=bool(progressed),
+            evictions=req.evictions,
+        )
         self._release_slot(s)
         self.evictions += 1
         if park:
             self.overload_parks += 1
             self.parked.append(req)
+            self._emit("parked", rid=req.rid, reason="overload")
         elif req.thrash >= self.park_threshold:
             self.livelock_parks += 1
             self.parked.append(req)
+            self._emit("parked", rid=req.rid, reason="livelock")
         else:
             self.queue.appendleft(req)
 
@@ -1488,7 +1631,9 @@ class ServingEngine:
         idle (nothing else will ever free pages, so parked work must
         retry)."""
         while self.parked:
-            self.queue.append(self.parked.pop(0))
+            req = self.parked.pop(0)
+            self._emit("resumed", rid=req.rid)
+            self.queue.append(req)
 
     def _ensure_growth(self) -> None:
         """Before the window, every decoding slot needs pages for up to K
@@ -1585,6 +1730,10 @@ class ServingEngine:
         """One speculative verify dispatch + harvest (the spec-mode
         replacement for the K-step decode window)."""
         drafts, n_draft = self._draft(decoding)
+        tele = self.telemetry
+        if tele is not None:
+            t0 = self.clock()
+            rids = tuple(self.slot_req[s].rid for s in decoding)
         (
             self.pool, self.logits, cand, emit, done_d, new_len,
             emitted_d, n_acc,
@@ -1614,6 +1763,21 @@ class ServingEngine:
         self.pooled_len = np.array(new_len, np.int32)
         self.emitted = np.array(emitted_d, np.int32)
         now = self.clock()
+        if tele is not None:
+            # timestamped at the existing harvest sync — tracing adds
+            # no device round-trip of its own
+            n_window = int(emit_h[np.asarray(decoding)].sum())
+            tele.record_dispatch(
+                "verify_dispatch", step=self.fault_step, t=t0,
+                dur=now - t0, rids=rids, tokens=n_window,
+                drafted=int(np.asarray(n_draft)[np.asarray(decoding)].sum()),
+                accepted=int(n_acc_h[np.asarray(decoding)].sum()),
+            )
+            self.metrics.histogram("dispatch_s").observe(now - t0)
+            tele.emit(
+                "verify_dispatch", step=self.fault_step, t=now,
+                slots=len(decoding), tokens=n_window,
+            )
         finished_any = False
         for s in decoding:
             req = self.slot_req[s]
@@ -1629,13 +1793,40 @@ class ServingEngine:
             self.tokens_generated += len(new)
             self._adapt_spec(req, int(n_draft[s]), int(n_acc_h[s]))
             self._register_pages(s)
+            if tele is not None:
+                tele.emit(
+                    "tokens", step=self.fault_step, t=now, rid=req.rid,
+                    n=len(new), total=len(req.tokens), slot=s,
+                )
             if self.done[s]:
-                req.finish_time = now
-                self.finished[req.rid] = req
-                self._release_slot(s)
+                self._finish_request(req, now, s)
                 finished_any = True
         if finished_any and self.parked:
             self._unpark()  # freed pages: parked requests get another shot
+
+    def _finish_request(self, req: Request, now: float, slot: int) -> None:
+        """Retire a finished request from its slot and observe the
+        finish-time histograms — TTFT/e2e always (the scheduler already
+        holds both timestamps), per-token TBT only under tracing (it
+        needs the telemetry token timeline)."""
+        req.finish_time = now
+        self.finished[req.rid] = req
+        if req.first_token_time is not None:
+            self.metrics.histogram("ttft_s").observe(
+                req.first_token_time - req.submit_time
+            )
+        self.metrics.histogram("e2e_s").observe(now - req.submit_time)
+        tele = self.telemetry
+        if tele is not None:
+            ts = tele.token_times(req.rid)
+            h = self.metrics.histogram("tbt_s")
+            for a, b in zip(ts, ts[1:]):
+                h.observe(b - a)
+            tele.emit(
+                "finished", step=self.fault_step, t=now, rid=req.rid,
+                tokens=len(req.tokens), evictions=req.evictions,
+            )
+        self._release_slot(slot)
 
     @property
     def has_work(self) -> bool:
@@ -1649,6 +1840,11 @@ class ServingEngine:
         a ``fault_hook`` is installed — always BEFORE any dispatch, so
         the engine's request state stays consistent and drainable."""
         self.fault_step += 1
+        if self.telemetry is not None:
+            # optional jax.profiler window (telemetry.profile_steps):
+            # host-driven start/stop at step boundaries, no effect on
+            # the compiled programs
+            self.telemetry.maybe_profile(self.fault_step)
         if self._fault_hook is not None:
             self._fault_hook(self)
         if self.parked and not self.queue and not self._active_slots():
@@ -1669,6 +1865,10 @@ class ServingEngine:
             self._run_verify(decoding)
             return True
 
+        tele = self.telemetry
+        if tele is not None:
+            t0 = self.clock()
+            rids = tuple(self.slot_req[s].rid for s in decoding)
         (
             self.pool, self.logits, toks, emit, done_d, new_len, emitted_d
         ) = self._window_fn(
@@ -1697,6 +1897,19 @@ class ServingEngine:
         self.pooled_len = np.array(new_len, np.int32)
         self.emitted = np.array(emitted_d, np.int32)
         now = self.clock()
+        if tele is not None:
+            # timestamped at the existing harvest sync — tracing adds
+            # no device round-trip of its own
+            n_window = int(emit_h[:, np.asarray(decoding)].sum())
+            tele.record_dispatch(
+                "decode_window", step=self.fault_step, t=t0, dur=now - t0,
+                rids=rids, tokens=n_window, window=self.window,
+            )
+            self.metrics.histogram("dispatch_s").observe(now - t0)
+            tele.emit(
+                "decode_window", step=self.fault_step, t=now,
+                slots=len(decoding), tokens=n_window,
+            )
         finished_any = False
         for s in decoding:
             req = self.slot_req[s]
@@ -1710,10 +1923,13 @@ class ServingEngine:
             # generated tokens fill pages too — register them so shared-
             # context traffic (multi-turn chat) hits on earlier turns
             self._register_pages(s)
+            if tele is not None:
+                tele.emit(
+                    "tokens", step=self.fault_step, t=now, rid=req.rid,
+                    n=len(new), total=len(req.tokens), slot=s,
+                )
             if self.done[s]:
-                req.finish_time = now
-                self.finished[req.rid] = req
-                self._release_slot(s)
+                self._finish_request(req, now, s)
                 finished_any = True
         if finished_any and self.parked:
             self._unpark()  # freed pages: parked requests get another shot
@@ -1781,15 +1997,62 @@ class ServingEngine:
     def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
         """Drive :meth:`step` until queue and slots drain; returns the
         finished requests by id."""
-        for _ in range(max_windows):
-            if not self.has_work:
-                break
-            self.step()
-        else:
-            raise RuntimeError(f"engine did not drain in {max_windows} windows")
+        try:
+            for _ in range(max_windows):
+                if not self.has_work:
+                    break
+                self.step()
+            else:
+                raise RuntimeError(
+                    f"engine did not drain in {max_windows} windows"
+                )
+        finally:
+            if self.telemetry is not None:
+                # a workload draining before the configured profiler
+                # stop step must still finalize the trace
+                self.telemetry.stop_profiling()
         return self.finished
 
     # -- reporting ----------------------------------------------------------
+
+    def metrics_snapshot(self) -> tp.Dict[str, tp.Any]:
+        """The full JSON-exportable registry view (counters, labeled
+        families, live gauges, fixed-bucket histograms) —
+        :meth:`stats` is the stable façade selecting from the same
+        registry (telemetry.ENGINE_STATS_KEYS contract)."""
+        return self.metrics.snapshot()
+
+    def flight_dump(
+        self,
+        reason: str,
+        path: tp.Optional[str] = None,
+        extra: tp.Optional[tp.Dict[str, tp.Any]] = None,
+    ) -> tp.Dict[str, tp.Any]:
+        """The flight-recorder artifact: the bounded event + dispatch
+        rings (when tracing is on), the metrics snapshot, and the stats
+        façade, as one JSON-able record — what the cluster's fault
+        paths and bench_serving's whole-trace watchdog persist so a
+        wedged run still yields a timeline (the r4/r5 lesson). Reads
+        host-side state only; safe to call best-effort from another
+        thread (the cold-failover case — see
+        telemetry.EngineTelemetry.flight_payload)."""
+        rec: tp.Dict[str, tp.Any] = {
+            "reason": reason,
+            "fault_step": self.fault_step,
+            "stats": self.stats(),
+            "metrics": self.metrics_snapshot(),
+            "telemetry": (
+                self.telemetry.flight_payload()
+                if self.telemetry is not None
+                else None
+            ),
+        }
+        if extra:
+            rec.update(extra)
+        if path is not None:
+            rec["path"] = os.path.abspath(path)
+            write_json(path, rec)
+        return rec
 
     def stats(self) -> tp.Dict[str, float]:
         occ = self.occupancy_sum / max(1, self.windows * self.slots)
@@ -1831,3 +2094,11 @@ class ServingEngine:
             "parked_requests": len(self.parked),
             "faults_injected": self.faults_injected,
         }
+
+
+# Attach the registry-backed counter properties (data descriptors, so
+# `engine.decode_dispatches += 1` and the bench's `setattr(e, name, 0)`
+# reset both route through the registry's Counter objects).
+for _name in _ENGINE_COUNTERS:
+    setattr(ServingEngine, _name, _counter_property(_name))
+del _name

@@ -1,0 +1,772 @@
+"""Serving telemetry: per-request lifecycle tracing, a metrics
+registry, and a fault flight recorder.
+
+The serving stack through PR 11 is feature-rich and machine-audited but
+blind at runtime: ``ServingEngine.stats()`` was a flat counter dict,
+per-request latency existed only as bench_serving's aggregate TTFT
+percentiles, and the two wedged hardware sessions (r4/r5) produced *no*
+timing data at all. This module is the observability substrate — four
+pieces, one design constraint:
+
+1. **Per-request lifecycle tracing** (:class:`EngineTelemetry`): typed
+   events — ``submit``, ``queued``, ``admitted``, ``prefill_chunk``,
+   ``decode_window``, ``verify_dispatch``, ``tokens``, ``evicted``,
+   ``parked``, ``resumed``, ``finished``, ``shed``, ``deferred``,
+   ``fault`` — keyed to *engine-local scheduler steps* (the FaultPlan
+   convention: a chaos replay produces the identical event *sequence*)
+   with monotonic wall-clock annotations from the engine's injectable
+   ``clock``. Wall-clock lives ONLY in the ``t``/``dur`` fields, never
+   in ``data``, so :meth:`EngineTelemetry.sequence_signature` (events
+   minus wall-clock) is replay-deterministic and directly comparable
+   across runs. Derived per-request metrics
+   (:meth:`EngineTelemetry.request_metrics`): queue delay, TTFT,
+   per-token TBT, eviction-stall time, tokens-per-dispatch.
+
+2. **A metrics registry** (:class:`MetricsRegistry`): counters, gauges
+   (callback-evaluated at snapshot), and fixed-bucket histograms. The
+   engine's ad-hoc counter attributes are registry-backed (properties
+   over :class:`Counter` objects), so the registry is the single source
+   and ``stats()`` is a stable façade over it — the exact key inventory
+   is the :data:`ENGINE_STATS_KEYS`/:data:`CLUSTER_STATS_KEYS` contract,
+   pinned by test. ``snapshot()`` is JSON-exportable.
+
+3. **A flight recorder**: a bounded ring of recent events plus the last
+   N dispatch records, dumped as a structured JSON artifact
+   (``ServingEngine.flight_dump``) from the cluster's fault paths
+   (replica crash, watchdog trip, exhausted retries — see
+   ``ServingCluster(flight_dir=...)``) and from bench_serving's
+   whole-trace watchdog — so a wedged hardware run yields a timeline,
+   not a bare ``{"status": "watchdog"}`` row.
+
+4. **Timeline export** in Chrome trace-event format
+   (:meth:`EngineTelemetry.chrome_trace` — request lanes + dispatch
+   lanes, openable in Perfetto / chrome://tracing), plus optional
+   ``jax.profiler`` start/stop hooks around a selected scheduler-step
+   window (``profile_dir``/``profile_steps``).
+
+**The hard constraint**: tracing must not perturb the dispatch
+pipeline. Telemetry is NOT a parameter of any program factory — an
+engine with tracing on selects the *identical cached jitted callables*
+(asserted by ``analysis.harness.prove_telemetry_inert`` and the
+``--telemetry`` audit leg), every emission reads only host-side state
+the scheduler already holds (no device access, no new syncs), and
+dispatch durations are stamped at the window's *existing* device->host
+harvest read. When disabled, each emission site costs one ``is None``
+check. Greedy streams with telemetry on are bitwise identical to
+telemetry off across the whole feature matrix (tests/test_telemetry.py).
+
+Granularity honesty: the engine emits tokens in window batches (K per
+dispatch), so per-token TBT is the gap between consecutive *harvest*
+timestamps — within one window the gap is 0, across windows it is the
+window's wall time. The percentiles therefore describe the cadence a
+streaming client would actually see from this engine, not a smoothed
+per-token rate.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import typing as tp
+
+__all__ = [
+    "CLUSTER_STATS_KEYS",
+    "Counter",
+    "DispatchRecord",
+    "ENGINE_STATS_KEYS",
+    "EngineTelemetry",
+    "Event",
+    "EVENT_KINDS",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS_S",
+    "MetricsRegistry",
+    "chrome_trace",
+    "percentile",
+]
+
+
+# ---------------------------------------------------------------------------
+# The stats() façade contract (satellite: pinned by tests/test_telemetry.py)
+# ---------------------------------------------------------------------------
+
+#: The exact key inventory of ``ServingEngine.stats()``. bench_serving
+#: and the r6 hardware queue read these keys by name; the registry
+#: refactor (counters behind properties) must never drop or rename one.
+ENGINE_STATS_KEYS: tp.Tuple[str, ...] = (
+    "tp",
+    "decode_dispatches",
+    "prefill_dispatches",
+    "copy_dispatches",
+    "tokens_generated",
+    "windows",
+    "slot_occupancy",
+    "evictions",
+    "free_pages",
+    "cached_pages",
+    "cold_reclaims",
+    "prompt_tokens_total",
+    "prefill_tokens_saved",
+    "prefill_tokens_computed",
+    "prefix_hit_rate",
+    "tokens_per_dispatch",
+    "verify_dispatches",
+    "spec_drafted_tokens",
+    "spec_accepted_tokens",
+    "spec_acceptance_rate",
+    "admission_rejected",
+    "reject_reasons",
+    "shed_requests",
+    "deferred_submits",
+    "livelock_parks",
+    "overload_parks",
+    "parked_requests",
+    "faults_injected",
+)
+
+#: ``ServingCluster.stats()`` = the summed engine inventory plus these
+#: cluster-level keys (aggregation: sums, except the documented means).
+CLUSTER_STATS_KEYS: tp.Tuple[str, ...] = ENGINE_STATS_KEYS + (
+    "dp_replicas",
+    "watchdog_trips",
+    "retries",
+    "failovers",
+    "requeued_requests",
+    "dead_replicas",
+    "replica_health",
+    "replica_health_reason",
+    "per_replica",
+)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+#: Fixed latency buckets (seconds) shared by every latency histogram:
+#: sub-ms through 10 s, roughly x2.5 per step. Fixed (not adaptive) so
+#: snapshots from different runs/replicas merge bucket-for-bucket.
+LATENCY_BUCKETS_S: tp.Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    """A monotone-by-convention integer metric. ``value`` is plainly
+    assignable (the bench's warmup reset relies on it)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, value: int = 0):
+        self.name = name
+        self.value = value
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """A point-in-time reading: either ``set()`` explicitly or backed by
+    a zero-arg callback evaluated at snapshot time (the registry's way
+    of exporting live engine state — pool occupancy, queue depth —
+    without mirroring writes into the hot path)."""
+
+    __slots__ = ("name", "fn", "value")
+
+    def __init__(self, name: str, fn: tp.Optional[tp.Callable[[], float]] = None):
+        self.name = name
+        self.fn = fn
+        self.value: float = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def read(self) -> float:
+        return self.fn() if self.fn is not None else self.value
+
+
+class Histogram:
+    """A fixed-bucket histogram: ``counts[i]`` counts observations
+    ``<= bounds[i]``, with one overflow bucket at the end. Bounds are
+    immutable after construction so snapshots merge across replicas."""
+
+    __slots__ = ("name", "bounds", "counts", "total", "count")
+
+    def __init__(self, name: str, bounds: tp.Sequence[float] = LATENCY_BUCKETS_S):
+        assert list(bounds) == sorted(bounds), "bucket bounds must ascend"
+        self.name = name
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for b in self.bounds:
+            if v <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.total += v
+        self.count += 1
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def to_dict(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "buckets": list(self.bounds),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum": self.total,
+        }
+
+
+class MetricsRegistry:
+    """Counters + gauges + histograms under get-or-create names, with a
+    JSON-exportable :meth:`snapshot`. ``attach_labels`` registers a
+    labeled counter family *by reference* (e.g. the engine's
+    ``reject_reasons`` dict) so the owner keeps mutating its own dict
+    and the snapshot sees it live."""
+
+    def __init__(self) -> None:
+        self.counters: tp.Dict[str, Counter] = {}
+        self.gauges: tp.Dict[str, Gauge] = {}
+        self.histograms: tp.Dict[str, Histogram] = {}
+        self._labels: tp.Dict[str, tp.Dict[str, int]] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def gauge(
+        self, name: str, fn: tp.Optional[tp.Callable[[], float]] = None
+    ) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name, fn)
+        elif fn is not None:
+            g.fn = fn
+        return g
+
+    def histogram(
+        self, name: str, bounds: tp.Sequence[float] = LATENCY_BUCKETS_S
+    ) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(name, bounds)
+        return h
+
+    def attach_labels(self, name: str, labels: tp.Dict[str, int]) -> None:
+        self._labels[name] = labels
+
+    def reset_histograms(self) -> None:
+        """Zero every histogram in place (bounds kept) — bench_serving's
+        post-warmup reset, next to the counter zeroing."""
+        for h in self.histograms.values():
+            h.reset()
+
+    def snapshot(self) -> tp.Dict[str, tp.Any]:
+        """One JSON-able view of everything: counters by value, gauges
+        evaluated now, histograms with bucket arrays, labeled families
+        copied. This is the superset ``stats()`` selects its façade
+        from."""
+        return {
+            "counters": {k: c.value for k, c in sorted(self.counters.items())},
+            "labeled": {k: dict(v) for k, v in sorted(self._labels.items())},
+            "gauges": {k: g.read() for k, g in sorted(self.gauges.items())},
+            "histograms": {
+                k: h.to_dict() for k, h in sorted(self.histograms.items())
+            },
+        }
+
+
+def percentile(sorted_vals: tp.Sequence[float], q: float) -> tp.Optional[float]:
+    """Nearest-rank percentile over an ascending list (None when empty)
+    — the same convention bench_serving's TTFT percentiles use."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+#: The lifecycle taxonomy. ``submit`` = accepted by admission control;
+#: ``queued`` = entered the wait queue (also fired by failover
+#: resubmission); ``admitted`` = took a decode slot; ``prefill_chunk`` /
+#: ``decode_window`` / ``verify_dispatch`` = one compiled-program launch
+#: (dispatch lanes); ``tokens`` = one slot's harvest from one dispatch;
+#: ``evicted``/``parked``/``resumed`` = the preemption/overload paths;
+#: ``finished`` = the request completed; ``shed``/``deferred`` =
+#: bounded-queue overload outcomes; ``fault`` = a scripted FaultPlan
+#: injection firing.
+EVENT_KINDS: tp.Tuple[str, ...] = (
+    "submit",
+    "queued",
+    "admitted",
+    "prefill_chunk",
+    "decode_window",
+    "verify_dispatch",
+    "tokens",
+    "evicted",
+    "parked",
+    "resumed",
+    "finished",
+    "shed",
+    "deferred",
+    "fault",
+)
+
+
+@dataclasses.dataclass
+class Event:
+    """One lifecycle event. ``step`` is the engine-local scheduler-step
+    counter (``engine.fault_step`` — the FaultPlan key space) and ``seq``
+    the per-telemetry emission index; both are replay-deterministic.
+    ``t`` is the engine clock's monotonic reading and is the ONLY
+    wall-clock field — ``data`` carries deterministic values (slots,
+    counts, reasons) exclusively, which is what makes
+    :meth:`EngineTelemetry.sequence_signature` exact across replays."""
+
+    seq: int
+    step: int
+    kind: str
+    rid: tp.Optional[int]
+    t: float
+    data: tp.Dict[str, tp.Any] = dataclasses.field(default_factory=dict)
+
+    def signature(self) -> tp.Tuple:
+        return (
+            self.seq, self.step, self.kind, self.rid,
+            tuple(sorted(self.data.items())),
+        )
+
+    def to_json(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "seq": self.seq,
+            "step": self.step,
+            "kind": self.kind,
+            "rid": self.rid,
+            "t": self.t,
+            **self.data,
+        }
+
+
+@dataclasses.dataclass
+class DispatchRecord:
+    """One compiled-program launch, as the scheduler saw it: ``t`` is
+    the pre-dispatch clock reading and ``dur`` runs to the window's
+    existing device->host harvest read (decode/verify) or the program
+    call's return (prefill — an enqueue under async dispatch; exact on
+    the synchronous CPU test backend). No syncs are added either way."""
+
+    seq: int
+    step: int
+    kind: str  # decode_window | verify_dispatch | prefill_chunk
+    t: float
+    dur: float
+    rids: tp.Tuple[int, ...]
+    tokens: int
+    data: tp.Dict[str, tp.Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "seq": self.seq,
+            "step": self.step,
+            "kind": self.kind,
+            "t": self.t,
+            "dur": self.dur,
+            "rids": list(self.rids),
+            "tokens": self.tokens,
+            **self.data,
+        }
+
+
+# ---------------------------------------------------------------------------
+# EngineTelemetry
+# ---------------------------------------------------------------------------
+
+
+class EngineTelemetry:
+    """Per-engine event log + flight-recorder rings.
+
+    Two views of one stream: ``request_log`` keeps every event per
+    request id (the timeline / derived-metrics view, bounded per
+    request), while ``events`` is the bounded *recency* ring the flight
+    recorder dumps (``ring`` events). ``dispatches`` is the companion
+    ring of the last ``dispatch_ring`` compiled-program launches.
+
+    ``profile_dir`` + ``profile_steps=(start, stop)`` arm the optional
+    ``jax.profiler`` hooks: the engine starts a profiler trace at the
+    top of scheduler step ``start`` and stops it at the top of ``stop``
+    — a bounded window around exactly the steps under investigation,
+    host-driven, with no effect on the compiled programs.
+    """
+
+    def __init__(
+        self,
+        *,
+        ring: int = 4096,
+        dispatch_ring: int = 512,
+        per_request_cap: int = 4096,
+        profile_dir: tp.Optional[str] = None,
+        profile_steps: tp.Optional[tp.Tuple[int, int]] = None,
+    ):
+        assert ring >= 1 and dispatch_ring >= 1 and per_request_cap >= 1
+        if profile_steps is not None:
+            assert profile_dir is not None, "profile_steps needs profile_dir"
+            assert profile_steps[0] < profile_steps[1], profile_steps
+        self.ring_capacity = ring
+        self.dispatch_ring_capacity = dispatch_ring
+        self.per_request_cap = per_request_cap
+        self.profile_dir = profile_dir
+        self.profile_steps = profile_steps
+        self._profiling = False
+        self.events: tp.Deque[Event] = collections.deque(maxlen=ring)
+        self.dispatches: tp.Deque[DispatchRecord] = collections.deque(
+            maxlen=dispatch_ring
+        )
+        self.request_log: tp.Dict[int, tp.List[Event]] = {}
+        self._seq = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def emit(
+        self,
+        kind: str,
+        *,
+        step: int,
+        t: float,
+        rid: tp.Optional[int] = None,
+        **data,
+    ) -> Event:
+        assert kind in EVENT_KINDS, kind
+        ev = Event(self._seq, step, kind, rid, t, data)
+        self._seq += 1
+        self.events.append(ev)
+        if rid is not None:
+            log = self.request_log.setdefault(rid, [])
+            if len(log) < self.per_request_cap:
+                log.append(ev)
+        return ev
+
+    def record_dispatch(
+        self,
+        kind: str,
+        *,
+        step: int,
+        t: float,
+        dur: float,
+        rids: tp.Sequence[int],
+        tokens: int,
+        **data,
+    ) -> DispatchRecord:
+        rec = DispatchRecord(
+            self._seq, step, kind, t, dur, tuple(rids), tokens, data
+        )
+        # dispatch records share the event seq space so the flight dump
+        # interleaves them unambiguously
+        self._seq += 1
+        self.dispatches.append(rec)
+        return rec
+
+    def reset(self) -> None:
+        """Drop everything recorded so far (bench_serving calls this
+        after warmup, next to re-arming the fault hooks, so the measured
+        trace's events start at seq 0 like its fault_steps do)."""
+        self.events.clear()
+        self.dispatches.clear()
+        self.request_log.clear()
+        self._seq = 0
+
+    # -- optional jax.profiler window --------------------------------------
+
+    def maybe_profile(self, step: int) -> None:
+        """Called by the engine at the top of each scheduler step (only
+        when telemetry is attached). Starts/stops a ``jax.profiler``
+        trace at the configured step boundaries; no-op without
+        ``profile_steps``."""
+        if self.profile_steps is None:
+            return
+        import jax
+
+        start, stop = self.profile_steps
+        if not self._profiling and step == start:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and step >= stop:
+            self.stop_profiling()
+
+    def stop_profiling(self) -> None:
+        """Stop an in-flight ``jax.profiler`` trace (idempotent). The
+        engine calls this when it drains, so a workload finishing
+        before the configured ``stop`` step still finalizes the trace
+        to ``profile_dir`` instead of leaving the profiler armed (a
+        dangling trace is unwritten AND makes the next ``start_trace``
+        in the process raise). Callers driving ``step()`` manually past
+        a drain should call it too."""
+        if not self._profiling:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._profiling = False
+
+    # -- replay determinism -------------------------------------------------
+
+    def sequence_signature(self) -> tp.Tuple[tp.Tuple, ...]:
+        """The event stream minus wall-clock: what a chaos replay must
+        reproduce exactly (the FaultPlan convention — events are keyed
+        to scheduler steps, and every ``data`` field is deterministic
+        under the engine's replay contract). Ring-bounded: compare runs
+        whose event count fits ``ring``."""
+        return tuple(ev.signature() for ev in self.events)
+
+    # -- derived per-request metrics ---------------------------------------
+
+    def token_times(self, rid: int) -> tp.List[float]:
+        """Each emitted token's harvest timestamp (a ``tokens`` event
+        with ``n`` tokens contributes ``n`` copies of its ``t``)."""
+        out: tp.List[float] = []
+        for ev in self.request_log.get(rid, ()):
+            if ev.kind == "tokens":
+                out.extend([ev.t] * ev.data.get("n", 0))
+        return out
+
+    def request_metrics(self, rid: int) -> tp.Optional[tp.Dict[str, tp.Any]]:
+        """Derived lifecycle metrics for one request (None if the rid
+        was never seen): queue delay (submit -> first admission), TTFT
+        (submit -> first token), the per-token TBT series (consecutive
+        harvest-timestamp gaps — see the module docstring's granularity
+        note), eviction-stall time (eviction/park -> re-admission, summed
+        over preemptions), tokens, and tokens-per-dispatch (dispatches =
+        harvests that included this request)."""
+        evs = self.request_log.get(rid)
+        if not evs:
+            return None
+        submit_t: tp.Optional[float] = None
+        first_admit_t: tp.Optional[float] = None
+        finish_t: tp.Optional[float] = None
+        stall = 0.0
+        stall_since: tp.Optional[float] = None
+        dispatches = 0
+        evictions = 0
+        for ev in evs:
+            if ev.kind in ("submit", "queued") and submit_t is None:
+                submit_t = ev.t
+            elif ev.kind == "admitted":
+                if first_admit_t is None:
+                    first_admit_t = ev.t
+                if stall_since is not None:
+                    stall += ev.t - stall_since
+                    stall_since = None
+            elif ev.kind in ("evicted", "parked"):
+                if ev.kind == "evicted":
+                    evictions += 1
+                if stall_since is None:
+                    stall_since = ev.t
+            elif ev.kind == "tokens":
+                dispatches += 1
+            elif ev.kind == "finished":
+                finish_t = ev.t
+        tok_ts = self.token_times(rid)
+        tbt = [b - a for a, b in zip(tok_ts, tok_ts[1:])]
+        return {
+            "rid": rid,
+            "queue_delay_s": (
+                first_admit_t - submit_t
+                if submit_t is not None and first_admit_t is not None
+                else None
+            ),
+            "ttft_s": (
+                tok_ts[0] - submit_t
+                if submit_t is not None and tok_ts
+                else None
+            ),
+            "tbt_s": tbt,
+            "eviction_stall_s": stall,
+            "evictions": evictions,
+            "tokens": len(tok_ts),
+            "dispatches": dispatches,
+            "tokens_per_dispatch": (
+                len(tok_ts) / dispatches if dispatches else None
+            ),
+            "e2e_s": (
+                finish_t - submit_t
+                if submit_t is not None and finish_t is not None
+                else None
+            ),
+            "finished": finish_t is not None,
+        }
+
+    def finished_request_metrics(self) -> tp.List[tp.Dict[str, tp.Any]]:
+        """Derived metrics for every request whose log ends in
+        ``finished`` — the population bench_serving's TBT/queue-delay
+        percentiles are computed over."""
+        out = []
+        for rid in self.request_log:
+            m = self.request_metrics(rid)
+            if m is not None and m["finished"]:
+                out.append(m)
+        return out
+
+    # -- flight recorder ----------------------------------------------------
+
+    def flight_payload(self) -> tp.Dict[str, tp.Any]:
+        """The ring contents as JSON-able structures. Snapshot-copies
+        under the GIL, so it is safe to call from another thread
+        best-effort (the cluster's cold watchdog path — the wedged step
+        thread may still append, and a dump that misses its last event
+        beats no dump, which is the r4/r5 lesson this exists for)."""
+        return {
+            "ring_capacity": self.ring_capacity,
+            "events": [ev.to_json() for ev in list(self.events)],
+            "dispatches": [d.to_json() for d in list(self.dispatches)],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+_REQ_PID = 1
+_DISPATCH_PID = 2
+_ENGINE_PID = 3
+_SPAN_FOR = {
+    # state entered at this event kind -> span name closed by the next
+    # lifecycle transition
+    "queued": "queued",
+    "admitted": "active",
+    "evicted": "requeued",
+    "parked": "parked",
+    "resumed": "queued",
+}
+_CLOSERS = ("queued", "admitted", "evicted", "parked", "resumed", "finished")
+
+
+def _span(name: str, t0: float, t1: float, tid: int, base: float, **args):
+    return {
+        "name": name,
+        "ph": "X",
+        "pid": _REQ_PID,
+        "tid": tid,
+        "ts": (t0 - base) * 1e6,
+        "dur": max(0.0, (t1 - t0)) * 1e6,
+        "args": args,
+    }
+
+
+def chrome_trace(tele: EngineTelemetry) -> tp.Dict[str, tp.Any]:
+    """Export a telemetry log as a Chrome trace-event JSON object
+    (``json.dump`` it to a file and open in Perfetto). Layout: one
+    process of request lanes (tid = request id; spans for the
+    queued/active/requeued/parked phases, instants for tokens and
+    faults) and one process of dispatch lanes (one lane per dispatch
+    kind, spans from the dispatch ring). Timestamps are microseconds
+    relative to the earliest recorded event."""
+    events: tp.List[tp.Dict[str, tp.Any]] = []
+    all_ts = [ev.t for evs in tele.request_log.values() for ev in evs]
+    all_ts += [d.t for d in tele.dispatches]
+    all_ts += [ev.t for ev in tele.events if ev.rid is None]
+    base = min(all_ts) if all_ts else 0.0
+
+    events.append({
+        "ph": "M", "pid": _REQ_PID, "name": "process_name",
+        "args": {"name": "requests"},
+    })
+    events.append({
+        "ph": "M", "pid": _DISPATCH_PID, "name": "process_name",
+        "args": {"name": "dispatches"},
+    })
+
+    for rid, evs in sorted(tele.request_log.items()):
+        events.append({
+            "ph": "M", "pid": _REQ_PID, "tid": rid, "name": "thread_name",
+            "args": {"name": f"request {rid}"},
+        })
+        open_name: tp.Optional[str] = None
+        open_t = 0.0
+        last_t = evs[-1].t if evs else 0.0
+        for ev in evs:
+            if ev.kind in _CLOSERS:
+                if open_name is not None:
+                    events.append(_span(open_name, open_t, ev.t, rid, base))
+                open_name = _SPAN_FOR.get(ev.kind)
+                open_t = ev.t
+            if ev.kind in ("tokens", "submit", "finished"):
+                events.append({
+                    "name": ev.kind,
+                    "ph": "i",
+                    "s": "t",
+                    "pid": _REQ_PID,
+                    "tid": rid,
+                    "ts": (ev.t - base) * 1e6,
+                    "args": dict(ev.data, step=ev.step),
+                })
+        if open_name is not None:
+            events.append(_span(open_name, open_t, last_t, rid, base))
+
+    # rid-less lifecycle events (shed/deferred at rejection time — no
+    # rid ever exists — and scripted fault injections) live only on the
+    # recency ring; render them as instants on an engine lane so
+    # overload and chaos show up in Perfetto next to the lanes they
+    # explain. (Window-summary events are rid-less too but already
+    # render as spans on the dispatch lanes — excluded here.)
+    ridless = [
+        ev for ev in tele.events
+        if ev.rid is None and ev.kind in ("shed", "deferred", "fault")
+    ]
+    if ridless:
+        events.append({
+            "ph": "M", "pid": _ENGINE_PID, "name": "process_name",
+            "args": {"name": "engine"},
+        })
+        for ev in ridless:
+            events.append({
+                "name": ev.kind,
+                "ph": "i",
+                "s": "p",
+                "pid": _ENGINE_PID,
+                "tid": 0,
+                "ts": (ev.t - base) * 1e6,
+                "args": dict(ev.data, step=ev.step),
+            })
+
+    lanes = {"decode_window": 0, "verify_dispatch": 1, "prefill_chunk": 2}
+    for kind, tid in lanes.items():
+        events.append({
+            "ph": "M", "pid": _DISPATCH_PID, "tid": tid,
+            "name": "thread_name", "args": {"name": kind},
+        })
+    for d in tele.dispatches:
+        events.append({
+            "name": d.kind,
+            "ph": "X",
+            "pid": _DISPATCH_PID,
+            "tid": lanes.get(d.kind, 3),
+            "ts": (d.t - base) * 1e6,
+            "dur": max(0.0, d.dur) * 1e6,
+            "args": dict(d.data, step=d.step, tokens=d.tokens,
+                         rids=list(d.rids)),
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_json(path: str, payload: tp.Dict[str, tp.Any]) -> str:
+    """Write a JSON artifact, creating parent directories; returns the
+    absolute path (what watchdog rows and flight dumps record
+    in-band)."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    return path

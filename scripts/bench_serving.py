@@ -17,6 +17,18 @@ clock, and emits ONE JSON record:
   serve_verify_dispatches     speculative verify dispatches
   serve_quant            int8 quantized weight path on/off
   serve_peak_hbm_bytes   device peak HBM after the trace (null on CPU)
+  serve_tbt_p50_ms / serve_tbt_p99_ms   per-token time-between-tokens at
+                         the harvest cadence (telemetry-derived; tokens
+                         land in fused K-token windows, so p50 collapses
+                         toward 0 as K grows and p99 shows the window
+                         wall time — serving.telemetry docstring)
+  serve_queue_delay_p50_ms / _p99_ms    submit -> first admission
+  serve_timeline_files   Perfetto-loadable Chrome trace timelines +
+                         per-request derived metrics + the metrics
+                         registry snapshot (--timeline_dir)
+  serve_flight_dumps     dead-replica flight-recorder artifacts from
+                         chaos runs; watchdog rows carry their dumps
+                         in-band under "flight_recorder"
   serve_bytes_per_token_static  the analysis/traffic.py static HBM
                          decomposition (weights + live KV + logits per
                          decode step, per chip under --tp) at the
@@ -172,6 +184,22 @@ def main() -> None:
                     help="capped-exponential-backoff retries for "
                     "transient dispatch errors before failover")
     ap.add_argument("--backoff_s", type=float, default=0.05)
+    ap.add_argument("--telemetry", choices=("on", "off"), default="on",
+                    help="per-request lifecycle tracing "
+                    "(serving.telemetry): on gives the record TBT and "
+                    "queue-delay percentiles and arms the flight "
+                    "recorder / timeline export. Tracing never touches "
+                    "the compiled programs (greedy streams are bitwise "
+                    "identical on/off; measured overhead is the "
+                    "host-side scheduler only — PERF.md) — 'off' exists "
+                    "to ladder exactly that claim on hardware")
+    ap.add_argument("--timeline_dir", default=None,
+                    help="write per-replica Chrome trace-event timelines "
+                    "(openable in Perfetto), the per-request derived "
+                    "metrics, and the metrics-registry snapshot under "
+                    "this directory; also where dead-replica "
+                    "flight-recorder dumps land on chaos runs "
+                    "(default: flight dumps go next to --out)")
     ap.add_argument("--deadline_s", type=float, default=900.0,
                     help="whole-trace watchdog: if the trace has not "
                     "drained by then, emit a structured "
@@ -213,15 +241,42 @@ def main() -> None:
     out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")
     run_done = threading.Event()
     phase = {"name": "init"}  # init -> warmup -> trace
+    # the watchdog fires from a daemon thread while main may be wedged
+    # inside a dispatch: engines land here after construction so the
+    # thread can dump their flight recorders (host-side rings,
+    # snapshot-copied under the GIL — best-effort by design)
+    holder = {"engines": ()}
 
     def _run_watchdog():
         if run_done.wait(args.deadline_s) or run_done.is_set():
             return
+        # flight-recorder dumps FIRST, path recorded in-band: the whole
+        # point of the telemetry layer is that a wedged run still
+        # yields a timeline, not a bare {"status": "watchdog"} row
+        flight = []
+        for i, e in enumerate(holder["engines"]):
+            try:
+                p = (
+                    os.path.join(
+                        args.timeline_dir, f"flight_replica{i}_watchdog.json"
+                    )
+                    if args.timeline_dir
+                    else os.path.splitext(os.path.abspath(out))[0]
+                    + f".flight{i}.json"
+                )
+                rec = e.flight_dump(
+                    "watchdog", path=p,
+                    extra={"replica": i, "phase": phase["name"]},
+                )
+                flight.append(rec["path"])
+            except Exception:  # noqa: BLE001 — a dump must not mask the row
+                pass
         row = {
             "status": "watchdog",
             "phase": phase["name"],
             "serve_shape": shape,
             "serve_deadline_s": args.deadline_s,
+            "flight_recorder": flight,
             "error": (
                 f"serving bench exceeded {args.deadline_s:.0f}s in the "
                 f"{phase['name']} phase (wedged TPU relay?)"
@@ -324,6 +379,10 @@ def main() -> None:
         kv_quant="int8" if args.kv_quant == "on" else None,
         paged_kernel=args.paged_kernel,
         layer_scan=args.layer_scan,
+        # telemetry=True gives each engine/replica its OWN
+        # EngineTelemetry (tracing never touches the compiled programs
+        # — the engines still hit the same program cache entries)
+        telemetry=args.telemetry == "on",
     )
     meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
     # fault injection and the dispatch watchdog live in the cluster's
@@ -340,12 +399,20 @@ def main() -> None:
             model, meshes=meshes, fault_plan=plan,
             dispatch_timeout_s=args.dispatch_timeout_s,
             max_retries=args.max_retries, backoff_s=args.backoff_s,
+            # dead-replica flight recorders (crash / watchdog trip /
+            # exhausted retries) land next to the timelines, or next to
+            # the bench record when no --timeline_dir was given
+            flight_dir=(
+                args.timeline_dir
+                or os.path.dirname(os.path.abspath(out))
+            ),
             **engine_kw,
         )
         engines = eng.engines
     else:
         eng = ServingEngine(model, mesh=meshes[0], **engine_kw)
         engines = [eng]
+    holder["engines"] = tuple(engines)
     # the engine resolved paged_kernel="auto" to a concrete backend;
     # the watchdog closure reads the rebound name
     shape = shape.replace(
@@ -375,6 +442,12 @@ def main() -> None:
                      "cold_reclaims", "verify_dispatches", "spec_drafted",
                      "spec_accepted"):
             setattr(e, attr, 0)
+        # telemetry + histogram reset: the measured trace's timeline and
+        # latency distributions must start at zero like its fault_steps
+        # and counters do
+        e.metrics.reset_histograms()
+        if e.telemetry is not None:
+            e.telemetry.reset()
     if use_cluster:
         eng.finished.clear()
         eng._route.clear()
@@ -519,6 +592,54 @@ def main() -> None:
         if ttfts else (lambda q: None)
     )
     st = eng.stats()
+
+    # telemetry-derived per-request latency percentiles + timeline
+    # artifacts (serving.telemetry). TBT granularity honesty: the
+    # engine emits tokens in window batches, so the per-token gaps are
+    # the HARVEST cadence a streaming client would see (0 within one
+    # fused window, the window wall time across windows) — the p99 is
+    # the interesting lane, the p50 collapses toward 0 as K grows.
+    from midgpt_tpu.serving.telemetry import (
+        chrome_trace,
+        percentile,
+        write_json,
+    )
+
+    teles = [
+        (i, e.telemetry)
+        for i, e in enumerate(engines)
+        if e.telemetry is not None
+    ]
+    req_metrics = [m for _, t in teles for m in t.finished_request_metrics()]
+    tbts = sorted(dt * 1e3 for m in req_metrics for dt in m["tbt_s"])
+    qdelays = sorted(
+        m["queue_delay_s"] * 1e3
+        for m in req_metrics
+        if m["queue_delay_s"] is not None
+    )
+    pms = (  # noqa: E731
+        lambda vals, q: (
+            round(percentile(vals, q), 3) if vals else None
+        )
+    )
+    timeline_files = []
+    if args.timeline_dir and teles:
+        for i, t in teles:
+            timeline_files.append(write_json(
+                os.path.join(args.timeline_dir, f"timeline_replica{i}.json"),
+                chrome_trace(t),
+            ))
+        timeline_files.append(write_json(
+            os.path.join(args.timeline_dir, "request_metrics.json"),
+            {"requests": req_metrics},
+        ))
+        # the registry snapshot (counters + gauges + histograms) rides
+        # along so an r6 rung's row has its dispatch-level breakdown
+        # next to the ms/tok headline
+        timeline_files.append(write_json(
+            os.path.join(args.timeline_dir, "metrics_snapshot.json"),
+            eng.metrics_snapshot(),
+        ))
     # goodput under faults: each finished request's tokens count exactly
     # once, however many times faults made the engines recompute them.
     # serve_tok_s (tokens_generated) stays the raw engine WORK rate — a
@@ -563,6 +684,18 @@ def main() -> None:
         "serve_tok_s": round(st["tokens_generated"] / wall, 1),
         "serve_ttft_p50_ms": pct(0.50),
         "serve_ttft_p99_ms": pct(0.99),
+        # telemetry-derived (serving.telemetry; null with --telemetry
+        # off): time-between-tokens at the harvest cadence and
+        # submit->first-admission queue delay
+        "serve_telemetry": args.telemetry,
+        "serve_tbt_p50_ms": pms(tbts, 0.50),
+        "serve_tbt_p99_ms": pms(tbts, 0.99),
+        "serve_queue_delay_p50_ms": pms(qdelays, 0.50),
+        "serve_queue_delay_p99_ms": pms(qdelays, 0.99),
+        "serve_timeline_files": timeline_files or None,
+        "serve_flight_dumps": (
+            list(eng.flight_dumps) if use_cluster else []
+        ) or None,
         "serve_slot_occupancy": st["slot_occupancy"],
         "serve_decode_dispatches": st["decode_dispatches"],
         "serve_prefill_dispatches": st["prefill_dispatches"],

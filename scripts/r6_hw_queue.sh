@@ -52,8 +52,16 @@ run decode python scripts/bench_decode.py
 # K-step decode dispatch) — tok/s, TTFT p50/p99, occupancy, dispatch
 # count at the 124M shape under a Poisson mix; writes
 # artifacts/bench_serving.json. A K-ladder probes the dispatch-latency
-# amortization the subsystem exists for.
-run serving python scripts/bench_serving.py --platform=tpu
+# amortization the subsystem exists for. Telemetry is ON by default on
+# every serving rung (tracing never touches the compiled programs —
+# greedy streams are bitwise on/off, serving.telemetry), so each row
+# carries serve_tbt_* / serve_queue_delay_* percentiles; rungs with
+# --timeline_dir additionally persist a Perfetto-loadable per-request
+# timeline + the metrics-registry snapshot — so even a wedged run
+# leaves a dispatch-level timeline (the bench watchdog dumps the flight
+# recorder in-band to the row on a trip).
+run serving python scripts/bench_serving.py --platform=tpu \
+  --timeline_dir artifacts/r6/tl_serving
 run serving_k1 python scripts/bench_serving.py --platform=tpu --window 1 \
   --out artifacts/bench_serving_k1.json
 run serving_k16 python scripts/bench_serving.py --platform=tpu --window 16 \
@@ -138,18 +146,31 @@ run serving_kernel_on_kvq_on python scripts/bench_serving.py \
 # r5's 0.905 ms/tok and the 0.278/0.139 ms HBM floors. Each record
 # carries its static structure in-band (serve_static_launches_per_window
 # / serve_static_inlined_layer_bodies / serve_static_layer_scan_length).
+# The fused rung pair carries full timelines (PR 12 telemetry): the
+# per-dispatch lanes in the Perfetto trace + the dispatch_s histogram
+# in metrics_snapshot.json give the fused-vs-unfused comparison its
+# dispatch-level timing breakdown, not just the ms/tok headline.
 run serving_fuse_off_tp1 python scripts/bench_serving.py \
   --platform=tpu --quant on --kv_quant on --layer_scan off \
+  --timeline_dir artifacts/r6/tl_fuse_off_tp1 \
   --out artifacts/bench_serving_fuse_off_tp1.json
 run serving_fuse_on_tp1 python scripts/bench_serving.py \
   --platform=tpu --quant on --kv_quant on --layer_scan on \
+  --timeline_dir artifacts/r6/tl_fuse_on_tp1 \
   --out artifacts/bench_serving_fuse_on_tp1.json
 run serving_fuse_off_tp2 python scripts/bench_serving.py \
   --platform=tpu --quant on --kv_quant on --layer_scan off --tp 2 \
+  --timeline_dir artifacts/r6/tl_fuse_off_tp2 \
   --out artifacts/bench_serving_fuse_off_tp2.json
 run serving_fuse_on_tp2 python scripts/bench_serving.py \
   --platform=tpu --quant on --kv_quant on --layer_scan on --tp 2 \
+  --timeline_dir artifacts/r6/tl_fuse_on_tp2 \
   --out artifacts/bench_serving_fuse_on_tp2.json
+# Tracing-overhead rung (PERF.md target: <2% on, unmeasurable off):
+# the headline trace re-run with --telemetry off — the delta vs the
+# default rung above IS the measured tracing overhead on hardware.
+run serving_tele_off python scripts/bench_serving.py --platform=tpu \
+  --telemetry off --out artifacts/bench_serving_tele_off.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -476,6 +476,64 @@ def test_chaos_composite_plan_bit_identical(model):
     _chaos_matrix_case(model, True, None, 0, None)
 
 
+def test_chaos_telemetry_flight_dumps_and_replay(model, cluster_case,
+                                                 tmp_path):
+    """Chaos + telemetry composition (serving.telemetry): one composite
+    plan drives every terminal fault path — crash (warm failover),
+    wedge past the watchdog (cold), and exhausted transient retries —
+    with tracing ON and a flight_dir armed. Surviving streams stay
+    bit-identical to the fault-free run, the replayed run produces
+    IDENTICAL per-replica event sequences (wall-clock annotations
+    excluded — Event.signature), and every dead replica left a
+    flight-recorder artifact carrying its event/dispatch rings
+    including the scripted injection that killed it."""
+    import json
+    import os
+
+    prompts, kw, refs = cluster_case
+    # replica 0 crashes, replica 1 wedges into the 0.5 s watchdog,
+    # replica 2 exhausts max_retries=2 transients (steps 2, 3, 4 — the
+    # retries re-enter step()), replica 3 survives and drains everything
+    spec = "2:crash@0;2:wedge@1:1.5;2:transient@2;3:transient@2;4:transient@2"
+
+    def run(sub):
+        d = tmp_path / sub
+        d.mkdir()
+        cl = ServingCluster(
+            model, replicas=4, fault_plan=FaultPlan.parse(spec),
+            dispatch_timeout_s=0.5, max_retries=2, backoff_s=0.0,
+            telemetry=True, flight_dir=str(d), **kw,
+        )
+        rids = [cl.submit(p, 8, seed=i) for i, p in enumerate(prompts)]
+        _drive(cl, lambda: [cl.engines[i] for i in cl._alive()])
+        return cl, [list(map(int, cl.finished[r].tokens)) for r in rids]
+
+    cl, got = run("a")
+    assert got == refs, "surviving streams must stay bit-identical"
+    assert cl.health == ["dead", "dead", "dead", "healthy"]
+    assert {os.path.basename(p) for p in cl.flight_dumps} == {
+        "flight_replica0_crashed.json",
+        "flight_replica1_wedged.json",
+        "flight_replica2_transient_exhausted.json",
+    }, "crash, watchdog, and exhausted-retry paths must all dump"
+    for p in cl.flight_dumps:
+        rec = json.load(open(p))
+        assert rec["telemetry"]["events"], p
+        assert any(
+            e["kind"] == "fault" for e in rec["telemetry"]["events"]
+        ), f"{p} must record the scripted injection"
+        assert rec["stats"]["faults_injected"] >= 1
+
+    sigs = [t.sequence_signature() for t in cl.telemetries]
+    assert all(len(s) > 0 for s in sigs)
+    cl2, got2 = run("b")
+    assert got2 == got
+    assert [t.sequence_signature() for t in cl2.telemetries] == sigs, (
+        "replaying the same plan must reproduce every replica's event "
+        "sequence exactly (wall clock excluded)"
+    )
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "prefix_cache,chunk,spec,kvq",
