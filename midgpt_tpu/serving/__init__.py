@@ -47,6 +47,19 @@ Public surface:
   façade's key contract), the fault flight recorder
   (``ServingEngine.flight_dump``, ``ServingCluster(flight_dir=...)``),
   and Perfetto-loadable timeline export.
+- :class:`~midgpt_tpu.serving.frontdoor.AsyncFrontDoor`,
+  :class:`~midgpt_tpu.serving.frontdoor.TokenStream`,
+  :class:`~midgpt_tpu.serving.frontdoor.VirtualClock` — the asyncio
+  streaming front door (ROADMAP item 3): per-request async token
+  streams at the window-harvest cadence, cancellation-safe teardown
+  (slot reclaim + cold page retire, invariants property-checked),
+  priority/deadline admission with awaitable backpressure, and a
+  manual-pump determinism seam (streams bit-identical to the
+  synchronous loop; chaos replays event-sequence-identical). The
+  engine-side policy underneath: ``submit(priority=, deadline_s=)``,
+  aging starvation-proof admission, pre-dispatch deadline sheds
+  (:class:`~midgpt_tpu.serving.faults.DeadlineExceeded`), and
+  ``cancel()`` (:class:`~midgpt_tpu.serving.faults.Cancelled`).
 - :func:`generate_served` — one-shot batch generation through the engine
   (the ``sample.py --serve`` path).
 """
@@ -60,7 +73,9 @@ import numpy as np
 from midgpt_tpu.serving.cluster import ServingCluster, serving_meshes
 from midgpt_tpu.serving.faults import (
     AdmissionRejected,
+    Cancelled,
     ClusterUnavailable,
+    DeadlineExceeded,
     FaultEvent,
     FaultPlan,
     PoolOverloaded,
@@ -68,6 +83,11 @@ from midgpt_tpu.serving.faults import (
     ServingFault,
     TransientDispatchError,
     WedgedDispatch,
+)
+from midgpt_tpu.serving.frontdoor import (
+    AsyncFrontDoor,
+    TokenStream,
+    VirtualClock,
 )
 from midgpt_tpu.serving.engine import (
     Request,
@@ -98,8 +118,11 @@ from midgpt_tpu.serving.paged import (
 
 __all__ = [
     "AdmissionRejected",
+    "AsyncFrontDoor",
     "CLUSTER_STATS_KEYS",
+    "Cancelled",
     "ClusterUnavailable",
+    "DeadlineExceeded",
     "ENGINE_STATS_KEYS",
     "EngineTelemetry",
     "FaultEvent",
@@ -116,7 +139,9 @@ __all__ = [
     "ServingCluster",
     "ServingEngine",
     "ServingFault",
+    "TokenStream",
     "TransientDispatchError",
+    "VirtualClock",
     "WedgedDispatch",
     "chrome_trace",
     "copy_page",

@@ -53,10 +53,11 @@ this TPxDP composition).
   cluster-level failover counters (watchdog trips, retries, failovers,
   re-queued requests, replica health).
 
-This is the seam the async front door (ROADMAP item 5) slots into:
-streaming/cancellation/priorities wrap ``submit``/``step`` here without
-touching the engines — and the health/failover layer beneath it is what
-lets that front door promise SLOs.
+This is the seam the async front door (serving.frontdoor, ROADMAP
+item 3 — shipped) slots into: streaming/cancellation/priorities wrap
+``submit``/``step``/``cancel``/``lookup`` here without touching the
+engines — and the health/failover layer beneath it is what lets that
+front door promise SLOs.
 """
 
 from __future__ import annotations
@@ -135,8 +136,9 @@ class ServingCluster:
     builds the standard TPxDP split); ``replicas=N`` without meshes runs
     N schedulers on the default device — still useful: it is the
     scheduler-correctness configuration the tests drive, and the
-    single-host shape the async front door (ROADMAP item 5) will
-    multiplex. All other keyword arguments go to every engine verbatim.
+    single-host shape the async front door (serving.frontdoor)
+    multiplexes. All other keyword arguments go to every engine
+    verbatim.
 
     Fault-tolerance knobs:
 
@@ -231,6 +233,10 @@ class ServingCluster:
         self._submitted: tp.Dict[int, tp.Tuple] = {}
         self._next_rid = 0
         self.finished: tp.Dict[int, Request] = {}
+        # post-admission terminal outcomes that are not completions
+        # (mirrors the per-engine dicts; harvested like finished)
+        self.cancelled: tp.Dict[int, Request] = {}
+        self.expired: tp.Dict[int, Request] = {}
         # one stepping thread per replica: ServingEngine.step blocks on
         # its window's device->host read, and a sequential loop would
         # keep replica B's devices idle while replica A's window
@@ -288,6 +294,9 @@ class ServingCluster:
         *,
         eos_id: tp.Optional[int] = None,
         seed: int = 0,
+        priority: int = 0,
+        deadline_s: tp.Optional[float] = None,
+        deadline: tp.Optional[float] = None,
     ) -> int:
         """Admit onto the least-loaded HEALTHY replica (lowest index on
         ties — deterministic, so a test trace routes identically every
@@ -313,11 +322,20 @@ class ServingCluster:
         order = sorted(
             alive, key=lambda j: (self._load(self.engines[j]), j)
         )
+        # the ABSOLUTE deadline is fixed here, at first cluster
+        # admission (unless the caller anchored it earlier — e.g. the
+        # front door at ARRIVAL time), and rides the submission record:
+        # a cold-failover re-serve must keep the ORIGINAL SLO, exactly
+        # like it keeps the original submit time (priority rides the
+        # same way)
+        if deadline is None and deadline_s is not None:
+            deadline = self.engines[order[0]].clock() + deadline_s
         local = None
         for n, i in enumerate(order):
             try:
                 local = self.engines[i].submit(
-                    prompt, max_new_tokens, eos_id=eos_id, seed=seed
+                    prompt, max_new_tokens, eos_id=eos_id, seed=seed,
+                    priority=priority, deadline=deadline,
                 )
                 break
             except (AdmissionRejected, PoolOverloaded) as exc:
@@ -338,14 +356,89 @@ class ServingCluster:
         self._submitted[rid] = (
             np.asarray(prompt, np.int32).reshape(-1).copy(),
             max_new_tokens, eos_id, seed, self.engines[i].clock(),
+            priority, deadline,
         )
         return rid
 
+    def cancel(self, rid: int) -> bool:
+        """Cancellation routing: tear the cluster-global request down on
+        whichever replica currently serves it (the route survives
+        failover, so this follows the request). Idempotent; returns
+        True when the request was live. The submission record drops
+        with the route — a cancelled request must never be re-served by
+        a later cold failover."""
+        route = self._route.get(rid)
+        if route is None:
+            return False
+        i, local = route
+        req = self.engines[i].lookup(local)
+        if self.health[i] == "dead" or req is None:
+            if req is not None and req.outcome != "pending":
+                # already terminal on the dead replica: harvest under
+                # its REAL outcome instead of relabeling it cancelled
+                dest = {
+                    "finished": self.finished,
+                    "cancelled": self.cancelled,
+                    "expired": self.expired,
+                }[req.outcome]
+                dest[rid] = req
+                del self._route[rid]
+                self._submitted.pop(rid, None)
+                return req.outcome == "cancelled"
+            # a cold-abandoned replica's engine is never touched again;
+            # the request exists only as the submission record now —
+            # dropping route + record IS the cancellation (it was going
+            # to be re-served from scratch)
+            req = self.engines[i].make_request(
+                self._submitted[rid][0], self._submitted[rid][1],
+                eos_id=self._submitted[rid][2],
+                seed=self._submitted[rid][3],
+            )
+            req.rid = local
+            req.outcome = "cancelled"
+            self.cancelled[rid] = req
+            del self._route[rid]
+            self._submitted.pop(rid, None)
+            return True
+        ok = self.engines[i].cancel(local)
+        if ok:
+            self.cancelled[rid] = self.engines[i].cancelled[local]
+            del self._route[rid]
+            self._submitted.pop(rid, None)
+        return ok
+
+    def lookup(self, rid: int) -> tp.Optional[Request]:
+        """The live or terminal :class:`Request` for a cluster-global
+        id (the front door's harvest seam). After a COLD failover the
+        returned object is the survivor's fresh re-serve — its token
+        list regrows the same stream from zero (determinism contract),
+        which is exactly what the front door's per-stream cursor
+        needs."""
+        for d in (self.finished, self.cancelled, self.expired):
+            req = d.get(rid)
+            if req is not None:
+                return req
+        route = self._route.get(rid)
+        if route is None:
+            return None
+        i, local = route
+        if self.health[i] == "dead":
+            return None  # between death and failover re-pointing
+        return self.engines[i].lookup(local)
+
     def _harvest(self) -> None:
         for rid, (i, local) in list(self._route.items()):
-            req = self.engines[i].finished.get(local)
+            e = self.engines[i]
+            req = e.finished.get(local)
+            dest = self.finished
+            if req is None:
+                req = e.cancelled.get(local)
+                dest = self.cancelled
+            if req is None:
+                req = e.expired.get(local)
+                dest = self.expired
             if req is not None:
-                self.finished[rid] = req
+                dest[rid] = req
                 del self._route[rid]
                 self._submitted.pop(rid, None)
 
@@ -421,10 +514,13 @@ class ServingCluster:
             return
         for grid in mine:
             if cold:
-                prompt, n, eos_id, seed, t0 = self._submitted[grid]
+                prompt, n, eos_id, seed, t0, prio, deadline = (
+                    self._submitted[grid]
+                )
                 j = self._least_loaded(alive)
                 req = self.engines[j].make_request(
-                    prompt, n, eos_id=eos_id, seed=seed
+                    prompt, n, eos_id=eos_id, seed=seed, priority=prio,
+                    deadline=deadline,
                 )
                 req.submit_time = t0
             else:

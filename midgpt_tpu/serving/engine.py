@@ -696,6 +696,21 @@ class Request:
     # would otherwise re-prefill in a loop instead of one waiting)
     admit_tokens: int = 0
     thrash: int = 0
+    # scheduling (the front-door admission policy, replacing FIFO):
+    # higher ``priority`` dispatches first; ``deadline`` is an ABSOLUTE
+    # engine-clock instant past which a still-undispatched request is
+    # shed (None = no SLO). queue_seq/queue_step are the scheduler's
+    # tie-break and aging bookkeeping: seq is the submission order
+    # within a priority band, step the scheduler step of the last
+    # enqueue (effective priority grows by ``priority_aging`` per step
+    # queued — the starvation-freedom mechanism).
+    priority: int = 0
+    deadline: tp.Optional[float] = None
+    queue_seq: int = 0
+    queue_step: int = 0
+    # terminal outcome: "pending" while live, then one of
+    # "finished" | "cancelled" | "expired" (deadline shed)
+    outcome: str = "pending"
 
     @property
     def done(self) -> bool:
@@ -727,6 +742,8 @@ _ENGINE_COUNTERS = (
     "deferred_submits",
     "livelock_parks",
     "overload_parks",
+    "cancelled_requests",
+    "deadline_shed_requests",
     "faults_injected",
 )
 
@@ -828,6 +845,7 @@ class ServingEngine:
         max_queue: tp.Optional[int] = None,
         overload_policy: str = "defer",
         park_threshold: int = 2,
+        priority_aging: float = 0.125,
         fault_hook: tp.Optional[tp.Callable[["ServingEngine"], None]] = None,
         telemetry: tp.Union[None, bool, EngineTelemetry] = None,
     ):
@@ -869,6 +887,17 @@ class ServingEngine:
         self.max_queue = max_queue
         self.overload_policy = overload_policy
         self.park_threshold = park_threshold
+        # priority admission (replaces FIFO): a queued request's
+        # effective priority is ``priority + priority_aging * steps
+        # queued`` — aging guarantees starvation-freedom (a request of
+        # priority p outranks every FRESH priority-P arrival within
+        # ceil((P - p) / priority_aging) scheduler steps of queue
+        # residence, ties broken oldest-first; with all-default
+        # priorities the order degenerates to exactly the old FIFO).
+        # Keyed to SCHEDULER STEPS, not wall clock — the determinism
+        # contract the front door's replay tests pin.
+        assert priority_aging >= 0.0, priority_aging
+        self.priority_aging = priority_aging
         # deterministic fault injection (serving.faults): called at the
         # top of every step() with this engine, AFTER fault_step
         # incremented and BEFORE any dispatch — zero-cost when absent
@@ -1086,7 +1115,20 @@ class ServingEngine:
         # a finish / quarantine release / idle engine un-parks them
         self.parked: tp.List[Request] = []
         self.finished: tp.Dict[int, Request] = {}
+        # post-admission terminal outcomes that are NOT completions:
+        # cancelled (submitter teardown) and expired (deadline shed
+        # before dispatch) — separate dicts so goodput accounting and
+        # the finished-equals-submitted test contracts stay exact
+        self.cancelled: tp.Dict[int, Request] = {}
+        self.expired: tp.Dict[int, Request] = {}
         self._next_rid = 0
+        self._queue_seq = 0  # fresh-submission order (priority tie-break)
+        # rid -> live Request (queued, parked, or in a slot): the O(1)
+        # side of lookup() — the front door's per-round harvest reads
+        # every live stream's progress through it, and a linear scan of
+        # queue+parked+slots per stream would make each round O(n^2)
+        # under a deep backlog
+        self._live: tp.Dict[int, Request] = {}
 
         if self.speculate:
             # speculation REPLACES the K-step window: every decode
@@ -1174,9 +1216,25 @@ class ServingEngine:
         *,
         eos_id: tp.Optional[int] = None,
         seed: int = 0,
+        priority: int = 0,
+        deadline_s: tp.Optional[float] = None,
+        deadline: tp.Optional[float] = None,
     ) -> int:
         """Queue a request; returns its id. Prompts are cropped to the last
         ``block_size - max_new_tokens`` tokens so the whole context fits.
+
+        ``priority`` (higher dispatches first; aged by
+        ``priority_aging`` per queued scheduler step so low priorities
+        provably cannot starve) and a deadline (``deadline_s`` relative
+        to now on this engine's clock, or ``deadline`` as an absolute
+        clock instant — the cluster's cold-failover record uses the
+        absolute form so a re-served request keeps its ORIGINAL SLO)
+        feed the admission policy: a request whose deadline passes
+        while still queued/parked is shed before dispatch
+        (``Request.outcome == "expired"``, the ``deadline_shed`` event,
+        the ``deadline_shed_requests`` counter — serving.faults
+        ``DeadlineExceeded`` is the exception form the front door
+        raises).
 
         Unservable requests raise :class:`AdmissionRejected` (permanent:
         a bad budget, an empty prompt, or a lifetime page demand larger
@@ -1184,8 +1242,9 @@ class ServingEngine:
         it); a full bounded wait queue raises AdmissionRejected under
         ``overload_policy="shed"`` or :class:`PoolOverloaded` under
         ``"defer"`` (transient — the caller's cue to back off and
-        resubmit). Both are counted in :meth:`stats` — overload must
-        show up in telemetry, not as a crash."""
+        resubmit; the front door turns it into awaitable backpressure).
+        Both are counted in :meth:`stats` — overload must show up in
+        telemetry, not as a crash."""
         if max_new_tokens < 1:
             self._reject("bad_budget", f"max_new_tokens {max_new_tokens} < 1")
         if max_new_tokens >= self.block:
@@ -1223,14 +1282,27 @@ class ServingEngine:
                 "queue_full",
                 f"wait queue at max_queue={self.max_queue}; retry later",
             )
+        if deadline is None and deadline_s is not None:
+            deadline = self.clock() + deadline_s
         req = self.make_request(
-            prompt, max_new_tokens, eos_id=eos_id, seed=seed
+            prompt, max_new_tokens, eos_id=eos_id, seed=seed,
+            priority=priority, deadline=deadline,
         )
         # the rid resubmit is about to assign — emitted here so the
-        # lifecycle reads submit -> queued in order
+        # lifecycle reads submit -> queued in order. Scheduling fields
+        # ride in the event data only when non-default, so existing
+        # replay signatures are untouched (priority is a deterministic
+        # caller input; the absolute deadline is a clock value and
+        # stays out — has_deadline is the deterministic projection).
+        extra: tp.Dict[str, tp.Any] = {}
+        if priority:
+            extra["priority"] = int(priority)
+        if deadline is not None:
+            extra["has_deadline"] = True
         self._emit(
             "submit", rid=self._next_rid, t=req.submit_time,
             prompt_tokens=int(req.prompt.size), budget=int(max_new_tokens),
+            **extra,
         )
         return self.resubmit(req)
 
@@ -1241,11 +1313,15 @@ class ServingEngine:
         *,
         eos_id: tp.Optional[int] = None,
         seed: int = 0,
+        priority: int = 0,
+        deadline: tp.Optional[float] = None,
     ) -> Request:
         """Build a :class:`Request` exactly as :meth:`submit` would —
         crop included — WITHOUT admission control or queueing. The
         cluster's cold-failover path uses this + :meth:`resubmit` to
-        re-serve an already-accepted request from scratch."""
+        re-serve an already-accepted request from scratch (``deadline``
+        is absolute, so the re-served request keeps its original
+        SLO)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         keep = self.block - max_new_tokens
         if prompt.size > keep:
@@ -1259,6 +1335,8 @@ class ServingEngine:
             seed=seed,
             submit_time=self.clock(),
             spec_k=self.speculate,
+            priority=int(priority),
+            deadline=deadline,
         )
 
     def resubmit(self, req: Request) -> int:
@@ -1270,6 +1348,10 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         req.rid = rid
+        req.queue_seq = self._queue_seq
+        self._queue_seq += 1
+        req.queue_step = self.fault_step  # aging baseline
+        self._live[rid] = req
         self.queue.append(req)
         self._emit(
             "queued", rid=rid, prompt_tokens=int(req.prompt.size),
@@ -1301,7 +1383,87 @@ class ServingEngine:
         self.queue.clear()
         out.extend(self.parked)
         self.parked.clear()
+        self._live.clear()  # every live request just left this engine
         return out
+
+    # -- cancellation + lookup (the front door's seams) ---------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Tear a live request down: queued/parked entries leave their
+        waiting structure, an in-flight slot is reclaimed IMMEDIATELY
+        (this is a host-side scheduler mutation — the next window simply
+        no longer carries the slot) and its pages release through the
+        same path a finish takes, so indexed pages retire COLD and
+        future prefix hits survive the cancellation. Mid-speculation
+        the per-slot write watermark already guarantees no stale draft
+        K/V ever landed in the pages, and COW refcounts unwind through
+        ``_release_slot``'s pins — the allocator/index invariants hold
+        after every cancel (property-tested by the front-door suite).
+
+        Returns True when ``rid`` was live; False for unknown or
+        already-terminal ids (idempotent — a double cancel is a no-op).
+        The outcome is recorded (``Request.outcome = "cancelled"``, the
+        ``cancelled`` event, the ``cancelled_requests`` counter), never
+        raised — :class:`~midgpt_tpu.serving.faults.Cancelled` is the
+        front door's exception form."""
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                del self.queue[i]
+                self._cancelled(req, where="queued")
+                return True
+        for i, req in enumerate(self.parked):
+            if req.rid == rid:
+                self.parked.pop(i)
+                self._cancelled(req, where="parked")
+                return True
+        for s in self._active_slots():
+            req = self.slot_req[s]
+            if req.rid == rid:
+                self._cancelled(req, where="slot", slot=s)
+                self._release_slot(s)
+                if self.parked:
+                    self._unpark()  # freed pages: parked work retries
+                return True
+        return False
+
+    def _cancelled(self, req: Request, **data) -> None:
+        req.outcome = "cancelled"
+        self.cancelled_requests += 1
+        self._live.pop(req.rid, None)
+        self.cancelled[req.rid] = req
+        self._emit(
+            "cancelled", rid=req.rid, tokens_emitted=len(req.tokens),
+            **data,
+        )
+
+    def _expire(self, req: Request, **data) -> None:
+        """Deadline shed: the request's deadline passed while it was
+        still waiting for dispatch (queued, or parked at release
+        time) — drop it before spending compute it can no longer bank
+        under the SLO."""
+        req.outcome = "expired"
+        self.deadline_shed_requests += 1
+        self._live.pop(req.rid, None)
+        self.expired[req.rid] = req
+        self._emit(
+            "deadline_shed", rid=req.rid, tokens_emitted=len(req.tokens),
+            **data,
+        )
+
+    def lookup(self, rid: int) -> tp.Optional[Request]:
+        """The :class:`Request` for an engine-local id, wherever its
+        lifecycle has it (queued, parked, in a slot, or terminal);
+        None for an unknown id. O(1) — the front door's harvest reads
+        every live stream's token progress through this each round.
+        The object is stable across evictions/parks within one engine,
+        so a cursor over ``req.tokens`` streams exactly the emitted
+        tokens."""
+        return (
+            self._live.get(rid)
+            or self.finished.get(rid)
+            or self.cancelled.get(rid)
+            or self.expired.get(rid)
+        )
 
     # -- internals ----------------------------------------------------------
 
@@ -1365,14 +1527,59 @@ class ServingEngine:
 
     # -- admission ----------------------------------------------------------
 
+    def _shed_expired_queued(self) -> None:
+        """Drop every queued request whose deadline already passed —
+        BEFORE dispatch, so no window is spent on tokens the SLO can no
+        longer bank. Zero-cost without deadlines: the clock is read
+        only when a deadline-carrying request is actually queued."""
+        now: tp.Optional[float] = None
+        for req in [r for r in self.queue if r.deadline is not None]:
+            if now is None:
+                now = self.clock()
+            if now > req.deadline:
+                self.queue.remove(req)
+                self._expire(req, where="queued")
+
+    def _select_queued(self) -> int:
+        """Index of the next request to admit. Two bands:
+
+        1. RESUMED work (``evictions > 0`` — eviction/park re-queues
+           with progress kept) goes first, in queue order: it holds an
+           in-flight budget promise and re-prefills mostly from cache,
+           and this reproduces the old appendleft-FIFO discipline
+           exactly.
+        2. Fresh submissions by aged effective priority
+           ``priority + priority_aging * (steps queued)``, FIFO
+           (``queue_seq``) within a band — so equal priorities ARE the
+           old FIFO, and a starved low priority provably ages past any
+           fixed higher priority (the front-door starvation test pins
+           the bound).
+
+        Deterministic: every key component is a scheduler-step or
+        submission-order quantity, never wall clock."""
+        best, best_key = 0, None
+        for i, req in enumerate(self.queue):
+            if req.evictions > 0:
+                key: tp.Tuple = (0, i)
+            else:
+                eff = req.priority + self.priority_aging * (
+                    self.fault_step - req.queue_step
+                )
+                key = (1, -eff, req.queue_seq)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
     def _admit(self) -> None:
+        self._shed_expired_queued()
         admitted = 0
         for s in range(self.slots):
             if not self.queue or admitted >= self._max_prefills:
                 break
             if self.slot_req[s] is not None:
                 continue
-            req = self.queue[0]
+            qi = self._select_queued()
+            req = self.queue[qi]
             p = int(req.prompt.size)
             # prefix-cache match, capped at p-1: the last prompt token is
             # ALWAYS recomputed — its forward pass is what produces the
@@ -1393,9 +1600,11 @@ class ServingEngine:
             need = pages_needed(p, self.page_size) - len(full)
             if not self._try_reserve(need):
                 # head-of-line blocks: unpin and wait for pages to free
+                # (deliberately no skip-ahead to a smaller request —
+                # bypassing the selected head would starve large ones)
                 self._release_pages(pinned)
                 break
-            self.queue.popleft()
+            del self.queue[qi]
             fresh = self.alloc.alloc(need)
             pages = full + fresh
             if cow_src is not None:
@@ -1625,14 +1834,33 @@ class ServingEngine:
             self.queue.appendleft(req)
 
     def _unpark(self) -> None:
-        """Move every parked request back onto the wait queue (FIFO).
+        """Release every parked request back onto the wait queue.
         Called when pages may have come back: a request finished, a
         fault-injected quarantine lifted, or the engine went otherwise
         idle (nothing else will ever free pages, so parked work must
-        retry)."""
+        retry).
+
+        Un-parking used to be blind FIFO; now (a) ordering is the
+        admission selector's job — released requests re-enter the queue
+        and ``_select_queued`` ranks them with everyone else (parked
+        work always carries ``evictions > 0``, so it rides the resumed
+        band and still beats fresh submissions, in park order), and
+        (b) a parked request whose deadline passed while it waited is
+        SHED here instead of re-queued — re-prefilling a request that
+        can no longer meet its SLO would burn exactly the pages its
+        peers are starved for (counted ``deadline_shed_requests``,
+        evented ``deadline_shed`` with ``where="parked"``)."""
+        now: tp.Optional[float] = None
         while self.parked:
             req = self.parked.pop(0)
+            if req.deadline is not None:
+                if now is None:
+                    now = self.clock()
+                if now > req.deadline:
+                    self._expire(req, where="parked")
+                    continue
             self._emit("resumed", rid=req.rid)
+            req.queue_step = self.fault_step  # aging restarts at release
             self.queue.append(req)
 
     def _ensure_growth(self) -> None:
@@ -1810,6 +2038,8 @@ class ServingEngine:
         holds both timestamps), per-token TBT only under tracing (it
         needs the telemetry token timeline)."""
         req.finish_time = now
+        req.outcome = "finished"
+        self._live.pop(req.rid, None)
         self.finished[req.rid] = req
         if req.first_token_time is not None:
             self.metrics.histogram("ttft_s").observe(
@@ -2092,6 +2322,10 @@ class ServingEngine:
             "livelock_parks": self.livelock_parks,
             "overload_parks": self.overload_parks,
             "parked_requests": len(self.parked),
+            # front-door outcomes (serving.frontdoor): submitter
+            # cancellations and pre-dispatch deadline sheds
+            "cancelled_requests": self.cancelled_requests,
+            "deadline_shed_requests": self.deadline_shed_requests,
             "faults_injected": self.faults_injected,
         }
 

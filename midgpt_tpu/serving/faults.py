@@ -12,6 +12,14 @@ Two things live here, and they are one design:
    :class:`ReplicaCrash` / :class:`WedgedDispatch` /
    :class:`TransientDispatchError`; :class:`ClusterUnavailable` is the
    end of the line (every replica dead with work still pending).
+   :class:`Cancelled` and :class:`DeadlineExceeded` are the two
+   POST-ADMISSION terminal outcomes the async front door
+   (serving.frontdoor) surfaces to a caller awaiting a stream's result:
+   the engine records them as ``Request.outcome`` (``"cancelled"`` /
+   ``"expired"``) plus counters and lifecycle events — a cancel or a
+   pre-dispatch deadline shed is a scheduled outcome, not a crash —
+   and the front door raises the exception form only from
+   ``TokenStream.result()``.
 
 2. **A scripted, replayable chaos harness.** A :class:`FaultPlan` is an
    ordered list of :class:`FaultEvent` s keyed to *engine-local
@@ -64,7 +72,9 @@ import typing as tp
 
 __all__ = [
     "AdmissionRejected",
+    "Cancelled",
     "ClusterUnavailable",
+    "DeadlineExceeded",
     "FaultEvent",
     "FaultPlan",
     "PoolOverloaded",
@@ -114,6 +124,45 @@ class PoolOverloaded(_ReasonedFault):
     """Transient overload backpressure: the request was NOT accepted
     but may be resubmitted later (``reason="queue_full"`` under the
     defer policy — the bounded wait queue is full right now)."""
+
+
+class Cancelled(ServingFault):
+    """The request was cancelled by its submitter after admission
+    (``ServingEngine.cancel`` / ``TokenStream.cancel``): its slot was
+    reclaimed and its pages released at the next scheduler boundary.
+    Never raised by the engine itself — the scheduler records the
+    outcome (``Request.outcome == "cancelled"``, the ``cancelled``
+    lifecycle event, the ``cancelled_requests`` counter); the async
+    front door raises this from ``TokenStream.result()`` so a caller
+    awaiting a full completion gets a typed outcome."""
+
+    def __init__(self, rid: int, tokens_emitted: int = 0):
+        self.rid = rid
+        self.tokens_emitted = tokens_emitted
+        super().__init__(
+            f"request {rid} cancelled after {tokens_emitted} tokens"
+        )
+
+
+class DeadlineExceeded(ServingFault):
+    """The request's deadline passed while it was still waiting for
+    dispatch (queued or parked), so the scheduler SHED it before
+    spending any more compute on it — tokens it would have emitted past
+    the deadline count for nothing under an SLO, and serving them
+    starves requests that can still meet theirs. Recorded as
+    ``Request.outcome == "expired"`` + the ``deadline_shed`` event +
+    the ``deadline_shed_requests`` counter; raised only by
+    ``TokenStream.result()``. A request already IN a decode slot is
+    never shed mid-flight — it finishes late and the bench counts it
+    deadline-missed instead."""
+
+    def __init__(self, rid: int, tokens_emitted: int = 0):
+        self.rid = rid
+        self.tokens_emitted = tokens_emitted
+        super().__init__(
+            f"request {rid} shed: deadline passed before dispatch "
+            f"({tokens_emitted} tokens emitted)"
+        )
 
 
 class ClusterUnavailable(ServingFault):

@@ -1,0 +1,468 @@
+"""Async streaming front door over the serving stack (ROADMAP item 3).
+
+``ServingEngine``/``ServingCluster`` are libraries driven by a
+synchronous loop: ``submit()`` then ``step()`` until drained, tokens
+harvested in bulk at the end. Millions of users need the four things
+that loop cannot give them — and this module adds exactly those, WITHOUT
+touching a single compiled program:
+
+1. **Per-request async token streams** (:class:`TokenStream`): tokens
+   surface to ``async for`` consumers at every window harvest — the
+   same cadence the engine's telemetry documents honestly (K tokens per
+   fused dispatch), with no added device syncs: the front door reads
+   the host-side ``Request.tokens`` progress the scheduler already
+   holds, through the engines' ``lookup()`` seam.
+
+2. **Cancellation-safe teardown**: ``TokenStream.cancel()`` reclaims
+   the slot and releases the pages at the next scheduler boundary (the
+   only consistent point of a library-driven engine — there is no
+   mid-dispatch host state to tear). Pages retire COLD through the same
+   path a finish takes, so prefix-cache hits survive the cancellation;
+   the speculative write watermark already guarantees no stale draft
+   K/V is resident, and COW pins unwind through the normal slot
+   release — the allocator identity (``free + held + cached +
+   quarantined == num_pages``) and the PrefixIndex invariants hold
+   after every step, property-checked when ``check_invariants=True``.
+
+3. **Priorities + deadlines with backpressure**: ``submit(priority=,
+   deadline_s=)`` feeds the engine's aging admission policy (higher
+   priority first, starvation-proof aging, deadline-expired work shed
+   BEFORE dispatch — serving.engine), and the bounded-queue overload
+   outcomes of PR 10 map onto awaitable backpressure: a ``defer``
+   outcome suspends the submitting coroutine until the queue drains
+   (retrying at each scheduler boundary), a ``shed`` outcome raises the
+   typed :class:`~midgpt_tpu.serving.faults.AdmissionRejected`
+   immediately.
+
+4. **A determinism contract**: scheduler decisions stay keyed to engine
+   steps, deadlines read the engine's injectable clock
+   (:class:`VirtualClock` for tests), and the front door adds NO
+   decision state of its own — so token streams through the front door
+   are bit-identical to the synchronous loop given the same admission
+   order, chaos plans replay event-sequence-identically, and telemetry
+   stays provably inert. :meth:`AsyncFrontDoor.pump` is the
+   deterministic manual-drive seam those tests pin; the background
+   driver (``async with fd:``) runs the very same round with the
+   blocking ``step()`` moved to a worker thread so the event loop stays
+   responsive mid-dispatch.
+
+The engine is NOT thread-safe, so all engine access is serialized:
+submissions and cancellations that arrive while a step is in flight
+wait for the step boundary (an ``asyncio`` event the round flips);
+everything else runs inline on the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import typing as tp
+
+import numpy as np
+
+from midgpt_tpu.serving.cluster import ServingCluster
+from midgpt_tpu.serving.engine import Request, ServingEngine
+from midgpt_tpu.serving.faults import (
+    Cancelled,
+    DeadlineExceeded,
+    PoolOverloaded,
+)
+
+__all__ = ["AsyncFrontDoor", "TokenStream", "VirtualClock"]
+
+Backend = tp.Union[ServingEngine, ServingCluster]
+
+_DONE = object()  # stream terminator sentinel
+
+
+class VirtualClock:
+    """An injectable, deterministically-advancing clock: pass one
+    instance as every engine's ``clock=`` AND read/advance it from the
+    test driver, and all deadline decisions become pure functions of
+    the drive schedule — the replay contract's time base. ``tick``
+    optionally auto-advances per read (still deterministic: the
+    engine's read count is replay-deterministic); the default 0.0
+    advances only via :meth:`advance`."""
+
+    def __init__(self, start: float = 0.0, tick: float = 0.0):
+        assert tick >= 0.0, tick
+        self.t = float(start)
+        self.tick = float(tick)
+
+    def __call__(self) -> float:
+        t = self.t
+        self.t += self.tick
+        return t
+
+    def advance(self, dt: float) -> float:
+        assert dt >= 0.0, dt
+        self.t += dt
+        return self.t
+
+
+@dataclasses.dataclass
+class _Submission:
+    """One accepted front-door submission's bookkeeping."""
+
+    rid: int
+    stream: "TokenStream"
+
+
+class TokenStream:
+    """One request's async token stream. Iterate to receive tokens as
+    the engine harvests them (``async for tok in stream``); iteration
+    ends when the request reaches ANY terminal outcome — read
+    ``stream.outcome`` (``"finished" | "cancelled" | "expired" |
+    "error"``) to tell which, or await :meth:`result` for the typed
+    form (returns the full token list, raises
+    :class:`~midgpt_tpu.serving.faults.Cancelled` /
+    :class:`~midgpt_tpu.serving.faults.DeadlineExceeded`).
+
+    ``tokens`` accumulates everything streamed so far — after a COLD
+    cluster failover the engine recomputes a re-served request from
+    scratch, and the stream's cursor deduplicates the regrown prefix
+    (bit-identical by the determinism contract), so consumers see every
+    token exactly once."""
+
+    def __init__(self, fd: "AsyncFrontDoor", rid: int, *, priority: int,
+                 deadline_s: tp.Optional[float]):
+        self._fd = fd
+        self.rid = rid
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.tokens: tp.List[int] = []
+        self.outcome: tp.Optional[str] = None
+        self.request: tp.Optional[Request] = None  # set at terminal
+        self._cursor = 0  # engine-side tokens already streamed
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._buf: tp.Deque[int] = collections.deque()
+        self._ended = False
+
+    def cancel(self) -> None:
+        """Request teardown: slot reclaim + page release at the next
+        scheduler boundary. Idempotent; safe any time before the stream
+        ends."""
+        if self.outcome is None:
+            self._fd.cancel(self.rid)
+
+    def __aiter__(self) -> "TokenStream":
+        return self
+
+    async def __anext__(self) -> int:
+        while not self._buf:
+            if self._ended:
+                raise StopAsyncIteration
+            item = await self._q.get()
+            if item is _DONE:
+                self._ended = True
+                raise StopAsyncIteration
+            self._buf.extend(item)
+        return self._buf.popleft()
+
+    async def result(self) -> tp.List[int]:
+        """Drain the stream and return the complete token list; raises
+        the typed outcome for a cancelled/expired/errored request."""
+        async for _ in self:
+            pass
+        if self.outcome == "cancelled":
+            raise Cancelled(self.rid, len(self.tokens))
+        if self.outcome == "expired":
+            raise DeadlineExceeded(self.rid, len(self.tokens))
+        if self.outcome == "error":
+            exc = self._fd.error
+            raise exc if exc is not None else RuntimeError(
+                f"request {self.rid} ended without an outcome"
+            )
+        return list(self.tokens)
+
+    # driver-side (event-loop thread only)
+
+    def _push(self, new: tp.Sequence[int]) -> None:
+        self.tokens.extend(int(t) for t in new)
+        self._q.put_nowait([int(t) for t in new])
+
+    def _finish(self, outcome: str, req: tp.Optional[Request]) -> None:
+        self.outcome = outcome
+        self.request = req
+        self._q.put_nowait(_DONE)
+
+
+class AsyncFrontDoor:
+    """The asyncio front door over one :class:`ServingEngine` or
+    :class:`ServingCluster`.
+
+    Two drive modes, one round:
+
+    - **Background driver** (``async with AsyncFrontDoor(backend) as
+      fd:`` or ``fd.start()``): a task loops cancels → step → harvest,
+      with the blocking ``step()`` in a worker thread
+      (``asyncio.to_thread``) so submissions/cancellations/consumers
+      stay responsive during a long dispatch. This is the serving mode
+      — bench_serving's trace-replay harness drives it.
+    - **Manual pump** (never call ``start()``; ``await fd.pump()`` per
+      round): fully deterministic — single-task, engine stepped inline,
+      scheduler decisions a pure function of the pump/submit/cancel
+      schedule. The bit-identity and replay acceptance tests drive
+      this seam.
+
+    Submissions run INLINE on the event loop whenever no step is in
+    flight (deterministic admission order = call order); otherwise they
+    wait for the step boundary. ``PoolOverloaded`` (the PR 10 defer
+    outcome) suspends the submitter until a later boundary admits it —
+    awaitable retry-after backpressure; ``AdmissionRejected`` (shed and
+    the permanent reasons) raises through.
+
+    ``check_invariants=True`` re-checks the page-allocator identity and
+    the PrefixIndex structural/refcount invariants on every live engine
+    after EVERY scheduler round — the cancellation-safety property
+    tests run with this armed.
+    """
+
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        backpressure: str = "wait",
+        check_invariants: bool = False,
+    ):
+        assert backpressure in ("wait", "raise"), backpressure
+        self.backend = backend
+        self.backpressure = backpressure
+        self.check_invariants = check_invariants
+        self.steps = 0
+        self.error: tp.Optional[BaseException] = None
+        self._streams: tp.Dict[int, TokenStream] = {}
+        self._cancels: tp.Deque[int] = collections.deque()
+        self._stepping = False
+        self._closed = False
+        self._task: tp.Optional[asyncio.Task] = None
+        self._boundary: asyncio.Event = asyncio.Event()
+        self._wake: asyncio.Event = asyncio.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background driver task (idempotent)."""
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._drive(), name="serving-frontdoor"
+            )
+
+    async def __aenter__(self) -> "AsyncFrontDoor":
+        self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def close(self) -> None:
+        """Stop the driver after the in-flight round settles. Live
+        streams are NOT cancelled — call :meth:`drain` first (or cancel
+        them) if the work should complete."""
+        self._closed = True
+        self._wake.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        # wake any submitter parked on a boundary (backpressure or
+        # mid-step wait): it re-checks closed/error and raises instead
+        # of hanging on an event no round will ever flip again
+        self._flip_boundary()
+
+    # -- submission ---------------------------------------------------------
+
+    def _engines(self) -> tp.List[ServingEngine]:
+        if isinstance(self.backend, ServingCluster):
+            cl = self.backend
+            return [cl.engines[i] for i in cl._alive()]
+        return [self.backend]
+
+    @property
+    def live_streams(self) -> int:
+        return len(self._streams)
+
+    async def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        *,
+        eos_id: tp.Optional[int] = None,
+        seed: int = 0,
+        priority: int = 0,
+        deadline_s: tp.Optional[float] = None,
+        deadline: tp.Optional[float] = None,
+        backpressure: tp.Optional[str] = None,
+    ) -> TokenStream:
+        """Admit a request and return its :class:`TokenStream`.
+
+        Runs inline when no engine step is in flight (admission order =
+        call order — the determinism contract); suspends until the step
+        boundary otherwise. On a full bounded queue: ``defer`` policy →
+        this coroutine WAITS (retrying each boundary) — the typed
+        backpressure of PR 10 as suspension instead of an exception;
+        ``shed`` policy / permanent reasons →
+        :class:`~midgpt_tpu.serving.faults.AdmissionRejected` raises
+        through (``backpressure="raise"`` makes defer outcomes raise
+        too, carrying ``reason="queue_full"``).
+
+        ``deadline`` is the ABSOLUTE engine-clock form (overrides
+        ``deadline_s``) — what an SLO anchored at ARRIVAL needs when
+        backpressure can delay the actual admission (the trace-replay
+        bench computes arrival + SLO up front, so time spent waiting in
+        this coroutine counts against the deadline)."""
+        bp = backpressure if backpressure is not None else self.backpressure
+        assert bp in ("wait", "raise"), bp
+        while True:
+            if self.error is not None:
+                raise self.error
+            if self._closed:
+                raise RuntimeError("front door is closed")
+            if self._stepping:
+                await self._next_boundary()
+                continue
+            try:
+                rid = self.backend.submit(
+                    prompt, max_new_tokens, eos_id=eos_id, seed=seed,
+                    priority=priority, deadline_s=deadline_s,
+                    deadline=deadline,
+                )
+            except PoolOverloaded:
+                if bp == "raise":
+                    raise
+                # awaitable retry-after: the queue is full NOW; the
+                # next scheduler boundary is the earliest it can drain
+                await self._next_boundary()
+                continue
+            stream = TokenStream(
+                self, rid, priority=priority, deadline_s=deadline_s
+            )
+            self._streams[rid] = stream
+            self._wake.set()
+            return stream
+
+    def cancel(self, rid: int) -> None:
+        """Queue a cancellation for the next scheduler boundary (the
+        engine is mid-step on another thread exactly when immediacy is
+        impossible anyway; at every other moment the boundary is now)."""
+        self._cancels.append(rid)
+        self._wake.set()
+        if not self._stepping and self._task is None:
+            # manual mode, engine idle: apply right away so a cancel of
+            # a queued request needs no pump to land
+            self._process_cancels()
+            self._harvest()
+
+    # -- the scheduler round ------------------------------------------------
+
+    def _process_cancels(self) -> None:
+        while self._cancels:
+            rid = self._cancels.popleft()
+            if rid in self._streams:
+                self.backend.cancel(rid)
+
+    def _flip_boundary(self) -> None:
+        ev, self._boundary = self._boundary, asyncio.Event()
+        ev.set()
+
+    async def _next_boundary(self) -> None:
+        await self._boundary.wait()
+
+    def _check(self) -> None:
+        for e in self._engines():
+            e.alloc.check()
+            if e.index is not None:
+                e.index.check(e.alloc)
+
+    def _harvest(self) -> None:
+        """Push newly-emitted tokens into every live stream and resolve
+        terminal outcomes — host-side reads only, through the backends'
+        ``lookup`` seam."""
+        be = self.backend
+        done: tp.List[int] = []
+        for rid, stream in self._streams.items():
+            req = be.lookup(rid)
+            if req is not None and len(req.tokens) > stream._cursor:
+                stream._push(req.tokens[stream._cursor:])
+                stream._cursor = len(req.tokens)
+            if req is not None and req.outcome != "pending":
+                stream._finish(req.outcome, req)
+                done.append(rid)
+            elif req is None and self.error is not None:
+                stream._finish("error", None)
+                done.append(rid)
+        for rid in done:
+            del self._streams[rid]
+
+    async def pump(self) -> bool:
+        """ONE deterministic scheduler round: pending cancellations →
+        one backend step (inline) → harvest (+ optional invariant
+        check) → boundary flip (wakes backpressured submitters).
+        Returns True while streams or backend work remain. This is the
+        manual-drive seam the determinism/replay tests pin; never mix
+        it with a running background driver."""
+        assert self._task is None, "pump() is the manual-drive seam; " \
+            "the background driver is already running this round"
+        await self._round(threaded=False)
+        return bool(self._streams) or self.backend.has_work
+
+    async def _round(self, threaded: bool) -> None:
+        self._process_cancels()
+        if self.backend.has_work:
+            self._stepping = True
+            try:
+                if threaded:
+                    await asyncio.to_thread(self.backend.step)
+                else:
+                    self.backend.step()
+            except BaseException as exc:  # noqa: BLE001 — typed faults
+                # (e.g. ClusterUnavailable) must terminate the streams,
+                # not strand their consumers; re-raised from result()
+                self.error = exc
+            finally:
+                self._stepping = False
+            self.steps += 1
+        self._harvest()
+        if self.error is not None:
+            for rid, stream in list(self._streams.items()):
+                stream._finish("error", None)
+                del self._streams[rid]
+        if self.check_invariants:
+            self._check()
+        self._flip_boundary()
+
+    async def _drive(self) -> None:
+        while not self._closed:
+            if self.backend.has_work or self._cancels:
+                await self._round(threaded=True)
+                if self.error is not None:
+                    return
+                # yield so same-loop consumers/submitters run between
+                # rounds even when the backend stays busy
+                await asyncio.sleep(0)
+            else:
+                self._harvest()  # e.g. cancels applied while idle
+                self._wake.clear()
+                if self._closed:
+                    return
+                await self._wake.wait()
+
+    # -- draining + reporting ----------------------------------------------
+
+    async def drain(self) -> None:
+        """Await until every accepted stream is terminal (driver mode:
+        sleeps on boundaries; manual mode: pumps)."""
+        while self._streams or self.backend.has_work:
+            if self.error is not None and not self._streams:
+                return
+            if self._task is not None:
+                await self._next_boundary()
+            else:
+                await self.pump()
+
+    def stats(self) -> tp.Dict[str, tp.Any]:
+        """The backend's stats plus the front door's own counters."""
+        st = dict(self.backend.stats())
+        st["frontdoor_steps"] = self.steps
+        st["frontdoor_live_streams"] = len(self._streams)
+        return st

@@ -123,6 +123,8 @@ ENGINE_STATS_KEYS: tp.Tuple[str, ...] = (
     "livelock_parks",
     "overload_parks",
     "parked_requests",
+    "cancelled_requests",
+    "deadline_shed_requests",
     "faults_injected",
 )
 
@@ -308,7 +310,10 @@ def percentile(sorted_vals: tp.Sequence[float], q: float) -> tp.Optional[float]:
 #: ``evicted``/``parked``/``resumed`` = the preemption/overload paths;
 #: ``finished`` = the request completed; ``shed``/``deferred`` =
 #: bounded-queue overload outcomes; ``fault`` = a scripted FaultPlan
-#: injection firing.
+#: injection firing; ``cancelled`` = the submitter tore the request
+#: down (slot reclaimed, pages released — serving.frontdoor);
+#: ``deadline_shed`` = the scheduler dropped a queued/parked request
+#: whose deadline passed before dispatch (the pre-dispatch SLO shed).
 EVENT_KINDS: tp.Tuple[str, ...] = (
     "submit",
     "queued",
@@ -324,6 +329,8 @@ EVENT_KINDS: tp.Tuple[str, ...] = (
     "shed",
     "deferred",
     "fault",
+    "cancelled",
+    "deadline_shed",
 )
 
 
@@ -650,7 +657,11 @@ _SPAN_FOR = {
     "parked": "parked",
     "resumed": "queued",
 }
-_CLOSERS = ("queued", "admitted", "evicted", "parked", "resumed", "finished")
+_CLOSERS = (
+    "queued", "admitted", "evicted", "parked", "resumed", "finished",
+    "cancelled", "deadline_shed",  # terminal like finished: close the
+    # open span, open nothing (absent from _SPAN_FOR)
+)
 
 
 def _span(name: str, t0: float, t1: float, tid: int, base: float, **args):
@@ -702,7 +713,8 @@ def chrome_trace(tele: EngineTelemetry) -> tp.Dict[str, tp.Any]:
                     events.append(_span(open_name, open_t, ev.t, rid, base))
                 open_name = _SPAN_FOR.get(ev.kind)
                 open_t = ev.t
-            if ev.kind in ("tokens", "submit", "finished"):
+            if ev.kind in ("tokens", "submit", "finished", "cancelled",
+                           "deadline_shed"):
                 events.append({
                     "name": ev.kind,
                     "ph": "i",

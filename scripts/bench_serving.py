@@ -59,6 +59,29 @@ what the prefix cache exists for; run it with --prefix_cache on/off to
 ladder the win. --prefill_chunk C prefills Sarathi-style in C-token
 chunks interleaved with decode (bounds TTFT under long prompts).
 
+Trace replay (--trace poisson|bursty|diurnal, serving.frontdoor): the
+goodput-under-SLO harness — the metric the Gemma-on-TPU serving paper
+(PAPERS.md) actually compares systems on. Seed-pinned arrival shapes
+(memoryless / burst-arrival / rate-swept "diurnal"), long-tail
+lognormal prompt lengths, shared-prefix TENANT mixes (--tenants K
+zipf-assigned system prompts of --sys_prompt_len tokens), per-request
+priorities (--priority_levels), per-request e2e deadlines (--slo_ms
+[+ --slo_per_token_ms x budget]), and client cancellations
+(--cancel_frac, after a seeded number of streamed tokens). The trace
+drives the ASYNC front door (AsyncFrontDoor token streams over the
+engine/cluster — so it composes with --fault_plan, --dp_replicas, and
+--timeline_dir unchanged) and the record gains:
+
+  serve_goodput_slo_tok_s   tokens from DEADLINE-MET requests only / wall
+  serve_deadline_met / serve_deadline_missed   finished in/after SLO
+  serve_deadline_shed       shed BEFORE dispatch (queued/parked expiry)
+  serve_cancelled           client-cancelled streams (slot reclaimed,
+                            pages retired cold)
+
+Deadline-expired requests shed pre-dispatch by the engine's priority/
+aging admission policy; tokens a late request still produced count in
+serve_tok_s (work done) but not in goodput-under-SLO (work banked).
+
 Chaos runs (--fault_plan "2:transient@0;4:crash@0", serving.faults spec
 grammar) drive the trace through a ServingCluster with scripted,
 deterministic fault injection: replica crashes/wedges/transient errors
@@ -184,6 +207,46 @@ def main() -> None:
                     help="capped-exponential-backoff retries for "
                     "transient dispatch errors before failover")
     ap.add_argument("--backoff_s", type=float, default=0.05)
+    ap.add_argument("--trace", choices=("off", "poisson", "bursty",
+                                        "diurnal"), default="off",
+                    help="trace-replay mode (serving.frontdoor): drive "
+                    "the request mix through the ASYNC front door with "
+                    "the named seed-pinned arrival shape — 'poisson' "
+                    "memoryless at --rate, 'bursty' Poisson burst "
+                    "epochs of --burst_size back-to-back arrivals, "
+                    "'diurnal' a sinusoidal rate sweep over the trace "
+                    "— plus long-tail lognormal prompt lengths; emits "
+                    "goodput-under-SLO next to the raw tok/s")
+    ap.add_argument("--burst_size", type=int, default=8,
+                    help="arrivals per burst epoch (--trace bursty)")
+    ap.add_argument("--slo_ms", type=float, default=0.0,
+                    help="per-request end-to-end SLO in ms from "
+                    "arrival (0 = no deadline): requests finishing "
+                    "late count deadline-missed, requests still "
+                    "queued/parked past it are SHED before dispatch "
+                    "(typed outcome), and serve_goodput_slo_tok_s "
+                    "counts deadline-met tokens only")
+    ap.add_argument("--slo_per_token_ms", type=float, default=0.0,
+                    help="extra SLO budget per requested token "
+                    "(deadline = arrival + slo_ms + slo_per_token_ms "
+                    "* max_new)")
+    ap.add_argument("--priority_levels", type=int, default=1,
+                    help="uniform seeded per-request priority in "
+                    "[0, L): the engine's aging admission dispatches "
+                    "high first, starvation-proof (1 = FIFO)")
+    ap.add_argument("--cancel_frac", type=float, default=0.0,
+                    help="fraction of requests whose client cancels "
+                    "the stream after a seeded number of tokens — "
+                    "exercises cancellation-safe teardown under load")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="shared-prefix tenant mix (--trace modes): K "
+                    "distinct --sys_prompt_len-token system prompts, "
+                    "zipf-ish assigned, replacing the single shared "
+                    "prefix of --sys_prompt_frac")
+    ap.add_argument("--max_queue", type=int, default=0,
+                    help="bounded engine wait queue (0 = unbounded): "
+                    "with the front door, defer outcomes become "
+                    "awaitable backpressure on the submitting client")
     ap.add_argument("--telemetry", choices=("on", "off"), default="on",
                     help="per-request lifecycle tracing "
                     "(serving.telemetry): on gives the record TBT and "
@@ -236,6 +299,11 @@ def main() -> None:
         f" kernel={args.paged_kernel} ls={args.layer_scan}"
         f" tp={args.tp} dp={args.dp_replicas}"
         f"{' faults=' + args.fault_plan if args.fault_plan else ''}"
+        f"{' trace=' + args.trace if args.trace != 'off' else ''}"
+        f"{f' slo={args.slo_ms:g}ms' if args.slo_ms else ''}"
+        f"{f' prio={args.priority_levels}' if args.priority_levels > 1 else ''}"
+        f"{f' cancel={args.cancel_frac:g}' if args.cancel_frac else ''}"
+        f"{f' tenants={args.tenants}' if args.tenants else ''}"
     )
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = args.out or os.path.join(repo, "artifacts", "bench_serving.json")
@@ -328,12 +396,82 @@ def main() -> None:
         model = quantize_model(model)
 
     rng = np.random.default_rng(args.seed)
-    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
-    plens = rng.integers(args.min_prompt, args.max_prompt + 1, args.requests)
+    # arrival process — seed-pinned so a trace replays identically:
+    # poisson (memoryless, the legacy default), bursty (Poisson burst
+    # EPOCHS of --burst_size back-to-back arrivals — flash-crowd
+    # shape), diurnal (interarrival rate swept sinusoidally through
+    # one "day" over the trace — peak/trough load in one run)
+    if args.trace == "bursty":
+        n_bursts = -(-args.requests // args.burst_size)
+        epochs = np.cumsum(
+            rng.exponential(args.burst_size / args.rate, n_bursts)
+        )
+        arrivals = np.repeat(epochs, args.burst_size)[: args.requests]
+    elif args.trace == "diurnal":
+        phase = 2.0 * np.pi * np.arange(args.requests) / max(
+            1, args.requests
+        )
+        inst_rate = args.rate * (1.0 + 0.8 * np.sin(phase))
+        arrivals = np.cumsum(
+            rng.exponential(1.0, args.requests) / np.maximum(
+                inst_rate, 1e-9
+            )
+        )
+    else:  # poisson (and the legacy synchronous path)
+        arrivals = np.cumsum(
+            rng.exponential(1.0 / args.rate, args.requests)
+        )
+    if args.trace != "off":
+        # long-tail prompt lengths: lognormal clipped into the
+        # configured band — the realistic mix (most prompts short, a
+        # heavy tail of long ones) the chunked-prefill path exists for
+        ln = rng.lognormal(
+            mean=np.log(max(2.0, args.min_prompt * 2.0)), sigma=0.8,
+            size=args.requests,
+        )
+        plens = np.clip(
+            ln.astype(np.int64), args.min_prompt, args.max_prompt
+        )
+    else:
+        plens = rng.integers(
+            args.min_prompt, args.max_prompt + 1, args.requests
+        )
     nnews = rng.integers(args.min_new, args.max_new + 1, args.requests)
+    # scheduling attributes (seed-pinned): priority levels, per-request
+    # deadlines, scripted client cancellations
+    priorities = (
+        rng.integers(0, args.priority_levels, args.requests)
+        if args.priority_levels > 1
+        else np.zeros(args.requests, np.int64)
+    )
+    deadlines_s = [
+        (args.slo_ms + args.slo_per_token_ms * int(nnews[i])) / 1e3
+        if args.slo_ms > 0 else None
+        for i in range(args.requests)
+    ]
+    cancel_mask = rng.random(args.requests) < args.cancel_frac
+    cancel_after = [
+        int(rng.integers(1, max(2, int(nnews[i]))))
+        if cancel_mask[i] else None
+        for i in range(args.requests)
+    ]
     sys_prompt = rng.integers(
         0, cfg.vocab_size, size=args.sys_prompt_len
     ).astype(np.int32)
+    # tenant mix: K distinct system prompts, zipf-ish popularity —
+    # the shared-prefix traffic shape at multi-tenant scale (tenant 0
+    # hottest, so its prefix chain stays resident across the trace)
+    tenant_of = None
+    if args.tenants > 0 and args.sys_prompt_len > 0:
+        weights = 1.0 / np.arange(1, args.tenants + 1)
+        tenant_of = rng.choice(
+            args.tenants, size=args.requests, p=weights / weights.sum()
+        )
+        tenant_prompts = [
+            rng.integers(0, cfg.vocab_size, size=args.sys_prompt_len)
+            .astype(np.int32)
+            for _ in range(args.tenants)
+        ]
     shared_mask = rng.random(args.requests) < args.sys_prompt_frac
     if args.repetitive:
         # self-repeating prompts: a short pattern tiled to length — the
@@ -354,14 +492,22 @@ def main() -> None:
         assert args.sys_prompt_len + args.max_prompt + args.max_new <= (
             cfg.block_size
         ), "system prompt + request mix must fit block_size"
-        prompts = [
-            np.concatenate([sys_prompt, p]) if shared_mask[i] else p
-            for i, p in enumerate(prompts)
-        ]
+        if tenant_of is not None:
+            prompts = [
+                np.concatenate([tenant_prompts[tenant_of[i]], p])
+                for i, p in enumerate(prompts)
+            ]
+        else:
+            prompts = [
+                np.concatenate([sys_prompt, p]) if shared_mask[i] else p
+                for i, p in enumerate(prompts)
+            ]
 
     from midgpt_tpu.serving import (
+        AdmissionRejected,
         ClusterUnavailable,
         FaultPlan,
+        PoolOverloaded,
         ServingCluster,
         serving_meshes,
     )
@@ -379,6 +525,7 @@ def main() -> None:
         kv_quant="int8" if args.kv_quant == "on" else None,
         paged_kernel=args.paged_kernel,
         layer_scan=args.layer_scan,
+        max_queue=args.max_queue or None,
         # telemetry=True gives each engine/replica its OWN
         # EngineTelemetry (tracing never touches the compiled programs
         # — the engines still hit the same program cache entries)
@@ -440,7 +587,8 @@ def main() -> None:
                      "occupancy_sum", "evictions", "prompt_tokens_total",
                      "prompt_tokens_cached", "prefill_tokens_computed",
                      "cold_reclaims", "verify_dispatches", "spec_drafted",
-                     "spec_accepted"):
+                     "spec_accepted", "cancelled_requests",
+                     "deadline_shed_requests"):
             setattr(e, attr, 0)
         # telemetry + histogram reset: the measured trace's timeline and
         # latency distributions must start at zero like its fault_steps
@@ -463,25 +611,94 @@ def main() -> None:
     phase["name"] = "trace"
     status, status_error = "ok", None
     t0 = time.monotonic()
-    submitted = 0
-    try:
-        while submitted < args.requests or eng.has_work:
-            now = time.monotonic() - t0
-            while submitted < args.requests and arrivals[submitted] <= now:
-                eng.submit(
-                    prompts[submitted], int(nnews[submitted]),
-                    seed=submitted,
-                )
-                submitted += 1
-            progressed = eng.step()
-            if not progressed and submitted < args.requests:
-                time.sleep(
-                    max(0.0, arrivals[submitted] - (time.monotonic() - t0))
-                )
-    except ClusterUnavailable as exc:
-        # every replica died with work pending: still a structured row —
-        # the goodput metrics below cover what DID finish
-        status, status_error = "unavailable", str(exc)
+    if args.trace != "off":
+        # ---- the async front-door drive (serving.frontdoor) ----
+        import asyncio
+
+        from midgpt_tpu.serving import AsyncFrontDoor
+
+        async def _drive_trace():
+            fd = AsyncFrontDoor(eng)
+            consumers = []
+
+            async def consume(i, stream):
+                n = 0
+                async for _tok in stream:
+                    n += 1
+                    if cancel_after[i] is not None and n >= cancel_after[i]:
+                        stream.cancel()
+
+            async with fd:
+                start = time.monotonic()
+                for i in range(args.requests):
+                    delay = arrivals[i] - (time.monotonic() - start)
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    # the SLO anchors at ARRIVAL (absolute deadline on
+                    # the engines' monotonic clock): time spent waiting
+                    # in submit backpressure counts against it — an
+                    # admission-anchored deadline would inflate goodput
+                    # exactly under the overload it is meant to measure
+                    stream = await fd.submit(
+                        prompts[i], int(nnews[i]), seed=i,
+                        priority=int(priorities[i]),
+                        deadline=(
+                            None if deadlines_s[i] is None
+                            else start + arrivals[i] + deadlines_s[i]
+                        ),
+                    )
+                    consumers.append(
+                        asyncio.create_task(consume(i, stream))
+                    )
+                await asyncio.gather(*consumers)
+                await fd.drain()
+            return fd
+
+        try:
+            fd = asyncio.run(_drive_trace())
+            if fd.error is not None:
+                raise fd.error
+        except ClusterUnavailable as exc:
+            status, status_error = "unavailable", str(exc)
+    else:
+        submitted = 0
+        try:
+            while submitted < args.requests or eng.has_work:
+                now = time.monotonic() - t0
+                while (
+                    submitted < args.requests
+                    and arrivals[submitted] <= now
+                ):
+                    try:
+                        eng.submit(
+                            prompts[submitted], int(nnews[submitted]),
+                            seed=submitted,
+                        )
+                    except PoolOverloaded:
+                        # bounded queue full (defer, --max_queue): step
+                        # below to drain, then retry this arrival — the
+                        # synchronous analogue of the front door's
+                        # awaitable backpressure
+                        break
+                    except AdmissionRejected as exc:
+                        if exc.reason != "queue_full":
+                            raise
+                        # shed policy: the request is dropped and
+                        # counted by the engine — move on
+                    submitted += 1
+                progressed = eng.step()
+                if not progressed and submitted < args.requests:
+                    time.sleep(
+                        max(
+                            0.0,
+                            arrivals[submitted]
+                            - (time.monotonic() - t0),
+                        )
+                    )
+        except ClusterUnavailable as exc:
+            # every replica died with work pending: still a structured
+            # row — the goodput metrics below cover what DID finish
+            status, status_error = "unavailable", str(exc)
     wall = time.monotonic() - t0
     t_end = time.monotonic()
     # the watchdog stays armed: the report phase still talks to the
@@ -648,6 +865,21 @@ def main() -> None:
     # progress is generated twice; the gap between the two rates is the
     # throughput the faults burned.
     good_tokens = sum(len(r.tokens) for r in eng.finished.values())
+    # goodput UNDER SLO (the trace-replay headline): only tokens from
+    # requests that finished WITHIN their deadline bank — a late finish
+    # is engine work (serve_tok_s) that earned nothing, a pre-dispatch
+    # shed never became work at all. Without --slo_ms every finish
+    # counts (goodput_slo == goodput).
+    met = [
+        r for r in eng.finished.values()
+        if r.deadline is None or (
+            r.finish_time is not None and r.finish_time <= r.deadline
+        )
+    ]
+    slo_tokens = sum(len(r.tokens) for r in met)
+    n_missed = len(eng.finished) - len(met)
+    n_cancelled = len(getattr(eng, "cancelled", {}))
+    n_expired = len(getattr(eng, "expired", {}))
     # recovery: wall-clock from the first replica death to trace drain
     first_fault = getattr(eng, "first_fault_time", None)
     record = {
@@ -711,6 +943,18 @@ def main() -> None:
         "serve_spec_drafted_tokens": st["spec_drafted_tokens"],
         "serve_spec_accepted_tokens": st["spec_accepted_tokens"],
         "serve_spec_acceptance_rate": st["spec_acceptance_rate"],
+        # trace replay / SLO accounting (serving.frontdoor)
+        "serve_trace": args.trace,
+        "serve_slo_ms": args.slo_ms or None,
+        "serve_priority_levels": args.priority_levels,
+        "serve_cancel_frac": args.cancel_frac,
+        "serve_tenants": args.tenants or None,
+        "serve_goodput_slo_tok_s": round(slo_tokens / wall, 1),
+        "serve_deadline_met": len(met),
+        "serve_deadline_missed": n_missed,
+        "serve_deadline_shed": st.get("deadline_shed_requests", 0),
+        "serve_cancelled": n_cancelled,
+        "serve_expired_requests": n_expired,
         # fault tolerance / overload degradation (serving.faults)
         "serve_fault_plan": args.fault_plan,
         "serve_requests_finished": len(eng.finished),

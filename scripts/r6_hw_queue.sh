@@ -171,6 +171,21 @@ run serving_fuse_on_tp2 python scripts/bench_serving.py \
 # default rung above IS the measured tracing overhead on hardware.
 run serving_tele_off python scripts/bench_serving.py --platform=tpu \
   --telemetry off --out artifacts/bench_serving_tele_off.json
+# NEW in PR 13: the SLO trace rung (serving.frontdoor) — goodput-under-
+# SLO on hardware, the metric the Gemma-on-TPU serving comparison
+# (PAPERS.md) ranks systems by. Bursty arrivals through the async front
+# door, a 4-tenant shared-prefix mix, 3 priority levels, a 2 s + 20 ms/
+# token e2e SLO, and 10% client cancellations: the row's headline pair
+# is serve_tok_s (work done) vs serve_goodput_slo_tok_s (work banked),
+# with serve_deadline_met/missed/shed and serve_cancelled explaining
+# the gap, and the timeline showing the priority/deadline scheduling
+# at dispatch granularity.
+run serving_slo_trace python scripts/bench_serving.py --platform=tpu \
+  --trace bursty --slo_ms 2000 --slo_per_token_ms 20 \
+  --priority_levels 3 --cancel_frac 0.1 \
+  --tenants 4 --sys_prompt_len 128 --max_prompt 128 \
+  --timeline_dir artifacts/r6/tl_slo_trace \
+  --out artifacts/bench_serving_slo_trace.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);
