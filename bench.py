@@ -26,12 +26,67 @@ from __future__ import annotations
 import dataclasses
 import gc
 import json
+import os
 import time
 
 import jax
 import numpy as np
 
 BASELINE_MFU = 0.478  # reference 1.5B on TPU v3-128 (README.md:55)
+
+# bench-run flight recorder (midgpt_tpu.train_telemetry): main() parks
+# the telemetry object here so the watchdog threads can dump the rung
+# timeline best-effort when the relay wedges — a watchdog/error row then
+# carries its flight-dump path IN-BAND, like bench_serving's rows do
+# (the r4/r5 wedged-run lesson applied to the training bench).
+_FLIGHT = {"tele": None, "dir": None}
+
+
+def _flight_dump(reason: str):
+    """Dump the rung-lifecycle flight record (None when telemetry never
+    armed or the dump fails — a dump must never mask the JSON row).
+    The filename carries the reason, so a mid-run watchdog dump and a
+    later error dump never overwrite each other's in-band paths."""
+    tele = _FLIGHT.get("tele")
+    if tele is None:
+        return None
+    try:
+        d = _FLIGHT.get("dir") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "artifacts"
+        )
+        name = "bench_flight_" + reason.replace(":", "_") + ".json"
+        return tele.flight_dump(reason, path=os.path.join(d, name))["path"]
+    except Exception:  # noqa: BLE001 — best-effort by design
+        return None
+
+
+def _train_attainment(cfg, n_dev: int, step_ms: float, prefix: str = ""):
+    """Roofline keys for one measured training rung: the static
+    compute/HBM floors (utils.metrics.train_floor — the SAME wiring
+    MetricLogger's logged series uses, so bench rows and training logs
+    can never disagree on the floor arithmetic) and attainment =
+    floor / measured, emitted next to the rung's MFU so BENCH_r*.json
+    rows read against the hardware ceiling without hand arithmetic.
+    Empty when the analytic floor doesn't cover the config
+    (best-effort, like the comms summary)."""
+    try:
+        from midgpt_tpu.utils.metrics import train_floor
+
+        fl = train_floor(cfg, n_dev)
+        if fl is None:
+            return {}
+        return {
+            prefix + "train_compute_floor_ms": fl["train_compute_floor_ms"],
+            prefix + "train_hbm_floor_ms": fl["train_hbm_floor_ms"],
+            prefix + "train_attainment_frac": (
+                # significant digits: CPU attainment is ~1e-8 and must
+                # not round to a hard zero
+                float(f"{fl['train_floor_ms_per_step'] / step_ms:.3g}")
+                if step_ms > 0 else None
+            ),
+        }
+    except Exception:  # noqa: BLE001 — attainment is best-effort
+        return {}
 
 # steps per timing sample: the scan-mode long chain fuses _SCAN_STEPS + 1
 # optimizer steps into one dispatch (train.make_train_window)
@@ -224,15 +279,18 @@ def _emit_bench_error(msg: str, status: str = "error") -> None:
     failure MODE machine-readable: "watchdog" rows are hardware wedges
     (the r4/r5 BENCH rows — a stuck TPU relay, not a regression);
     "error" rows are real failures. Trajectory tooling reading
-    BENCH_r*.json can then separate the two instead of treating every
-    bad round as a perf cliff."""
-    print(
-        json.dumps({
-            "metric": "bench_error", "value": 0, "unit": "none",
-            "vs_baseline": 0, "status": status, "error": msg[:400],
-        }),
-        flush=True,
-    )
+    BENCH_r*.json (analysis/ledger.py) can then separate the two
+    instead of treating every bad round as a perf cliff. The row
+    carries the rung-lifecycle flight-dump path in-band when telemetry
+    was armed — a wedge yields a timeline, not a bare error string."""
+    row = {
+        "metric": "bench_error", "value": 0, "unit": "none",
+        "vs_baseline": 0, "status": status, "error": msg[:400],
+    }
+    dump = _flight_dump(f"bench:{status}")
+    if dump:
+        row["flight_recorder"] = [dump]
+    print(json.dumps(row), flush=True)
 
 
 def _backend_watchdog(timeout_s: float = 600.0):
@@ -284,6 +342,9 @@ def _progress_watchdog(record: dict, done, deadline_s: float = 900.0):
         if "value" in record:
             record["partial"] = True
             record["status"] = "watchdog"
+            dump = _flight_dump("bench:watchdog")
+            if dump:
+                record["flight_recorder"] = [dump]
             print(json.dumps(record), flush=True)
             sys.stderr.write(
                 "bench watchdog: mid-run hang; emitted partial record\n"
@@ -302,6 +363,20 @@ def main() -> None:
     from midgpt_tpu.utils.metrics import flops_per_token, mfu
 
     t_start = time.perf_counter()
+
+    # rung-lifecycle flight recorder (midgpt_tpu.train_telemetry): armed
+    # BEFORE backend init, so even an init wedge dumps a timeline next
+    # to its watchdog row — jax-free construction, nothing touches the
+    # backend until the rungs run
+    from midgpt_tpu.train_telemetry import TrainTelemetry
+
+    tele = TrainTelemetry()
+    _FLIGHT["tele"] = tele
+    _rung = {"i": 0}
+
+    def _rev(kind: str, **data) -> None:
+        tele.emit(kind, step=_rung["i"], t=time.perf_counter(), **data)
+
     _init_done = _backend_watchdog()
 
     # persistent executable cache: repeat runs (and the fallback ladder)
@@ -339,6 +414,8 @@ def main() -> None:
         (8, 12 * n_dev), (6, 16 * n_dev), (8, 8 * n_dev),
     ):
         try:
+            _rung["i"] += 1
+            _rev("rung_start", rung=f"xl_L{xl_layers}_B{xl_batch}")
             xcfg, xstate, xchain, xmk = _run_config(
                 "none", xl_batch, base="openwebtext_xl", n_layer=xl_layers,
                 loss_chunk=512,
@@ -346,6 +423,7 @@ def main() -> None:
             xtps, xstep_ms, xstate, xmode = _rung_measure(
                 xcfg, xstate, xchain, xmk
             )
+            _rev("rung_ok", rung=f"xl_L{xl_layers}_B{xl_batch}")
             xmfu = mfu(xtps, xcfg.model, n_dev)
             # mutate IN PLACE: _progress_watchdog holds this dict
             record.clear()
@@ -365,6 +443,9 @@ def main() -> None:
                 # trainer's steps_per_dispatch knob; 1 = chained fallback)
                 "steps_per_dispatch": _fused_len(xmode),
             })
+            # roofline attainment next to the MFU headline: the static
+            # compute/HBM floors + floor/measured (analysis/traffic)
+            record.update(_train_attainment(xcfg, n_dev, xstep_ms))
             del xstate, xchain
             gc.collect()
             break
@@ -373,6 +454,7 @@ def main() -> None:
             # failed rung's device arrays (params + Adam moments) in HBM,
             # which would shrink the next rung's headroom
             exc.__traceback__ = None
+            _rev("rung_error", rung=f"xl_L{xl_layers}_B{xl_batch}")
             last_err = exc
             xcfg = xstate = xchain = None
             gc.collect()
@@ -389,8 +471,11 @@ def main() -> None:
         ("full", 16 * n_dev),
     ):
         try:
+            _rung["i"] += 1
+            _rev("rung_start", rung=f"gpt2s_{remat}_B{batch}")
             cfg, state, chain, mk = _run_config(remat, batch)
             tps, step_ms, state, _mode = _rung_measure(cfg, state, chain, mk)
+            _rev("rung_ok", rung=f"gpt2s_{remat}_B{batch}")
             small_mfu = mfu(tps, cfg.model, n_dev)
             record.update(
                 {
@@ -400,6 +485,7 @@ def main() -> None:
                     "gpt2s_tokens_per_sec_per_chip": round(tps / n_dev, 1),
                     "gpt2s_step_ms": round(step_ms, 1),
                     "gpt2s_remat": cfg.model.remat,
+                    **_train_attainment(cfg, n_dev, step_ms, "gpt2s_"),
                 }
             )
             if "value" not in record:  # XL never ran: promote to headline
@@ -422,6 +508,7 @@ def main() -> None:
             break
         except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
             exc.__traceback__ = None
+            _rev("rung_error", rung=f"gpt2s_{remat}_B{batch}")
             record["gpt2s_error"] = repr(exc)[:120]
             cfg = state = chain = None
             gc.collect()
@@ -468,6 +555,16 @@ def main() -> None:
             from scripts.bench_decode import measure_decode
 
             record.update(measure_decode())
+            # decode roofline attainment: the recorded HBM floor over
+            # the measured per-token latency (1.0 = bandwidth-bound
+            # perfection; decode_vs_floor is the same ratio inverted)
+            if record.get("decode_ms_per_tok") and record.get(
+                "decode_hbm_floor_ms"
+            ):
+                record["decode_attainment_frac"] = round(
+                    record["decode_hbm_floor_ms"]
+                    / record["decode_ms_per_tok"], 4,
+                )
         except Exception as exc:  # noqa: BLE001 — aux rung is best-effort
             exc.__traceback__ = None
             record["decode_error"] = repr(exc)[:120]

@@ -193,6 +193,50 @@ def build_parser() -> argparse.ArgumentParser:
         "seconds, decode-window and verify paths both.",
     )
     p.add_argument(
+        "--ledger", action="store_true",
+        help="run the perf-trajectory ledger (analysis.ledger) instead "
+        "of an HLO audit: ingest the BENCH_r*.json trajectory (+ any "
+        "--records-dir bench rows and the --suite-timing artifact), "
+        "diff the --record file(s) — or, with none, the newest OK "
+        "trajectory row — against it with per-key tolerance bands "
+        "(static byte/floor/dispatch keys gated hard everywhere; "
+        "wall-clock keys hard on hardware rows, informational on CPU), "
+        "render the --report markdown trend table, and exit 1 on any "
+        "hard regression. jax-free.",
+    )
+    p.add_argument(
+        "--record", action="append", default=[], metavar="PATH",
+        help="with --ledger: current bench record(s) to gate against "
+        "the trajectory (bench.py / bench_serving.py JSON rows, or a "
+        "BENCH_r*.json driver wrapper)",
+    )
+    p.add_argument(
+        "--records-dir", action="append", default=[], metavar="DIR",
+        help="with --ledger: directory of *.json bench records to "
+        "ingest into the reference trajectory (file order, after the "
+        "BENCH rounds)",
+    )
+    p.add_argument(
+        "--trajectory", default=None, metavar="DIR",
+        help="with --ledger: directory holding BENCH_r*.json "
+        "(default: the repo root)",
+    )
+    p.add_argument(
+        "--suite-timing", default=None, metavar="PATH",
+        help="with --ledger: the conftest suite-timing JSON artifact "
+        "(SUITE_TIMING_OUT) — tier-1 wall time joins the trend table",
+    )
+    p.add_argument(
+        "--report", default=None, metavar="PATH",
+        help="with --ledger: write the markdown trend report here",
+    )
+    p.add_argument(
+        "--hardware", choices=("auto", "on", "off"), default="auto",
+        help="with --ledger: gate wall-clock keys hard (on), "
+        "informationally (off), or by the record's own device field "
+        "(auto, the default)",
+    )
+    p.add_argument(
         "--mesh-shape", default=None, metavar="SPEC",
         help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
         "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
@@ -615,6 +659,24 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
 
     if args.lint is not None:
         return _run_lint(list(args.lint))
+    if args.ledger:
+        # jax-free: the ledger reads JSON records only — no devices, no
+        # config compile (it must run on any CI box in seconds)
+        from midgpt_tpu.analysis.ledger import run_ledger
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        return run_ledger(
+            trajectory_root=args.trajectory or repo_root,
+            records=args.record,
+            record_dirs=args.records_dir,
+            suite_timing=args.suite_timing,
+            report_path=args.report,
+            hardware={"auto": None, "on": True, "off": False}[
+                args.hardware
+            ],
+        )
     if not args.config:
         build_parser().print_usage(sys.stderr)
         print("error: --config (or --lint) is required", file=sys.stderr)

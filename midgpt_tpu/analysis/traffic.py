@@ -300,6 +300,85 @@ def floor_decomposition(
         "weights_floor_ms": round(w * to_ms, 4),
         "kv_floor_ms": round(kv * to_ms, 4),
         "floor_ms_per_step": round(total * to_ms, 4),
+        # per emitted token (a full-occupancy decode step emits one
+        # token per slot): the numerator of the serving attainment
+        # fraction — attainment = floor_ms_per_token / measured ms/tok.
+        # Significant digits, not decimals: tiny CPU test geometries
+        # sit at ~1e-5 ms and must not round to a hard zero.
+        "floor_ms_per_token": float(f"{total * to_ms / slots:.4g}"),
+    }
+
+
+def train_param_count(cfg) -> int:
+    """Analytic parameter count of a dense GPT config (jax-free mirror
+    of ``models.gpt.count_params`` PLUS the embedding table — the
+    optimizer state streams the embedding too, so the training-step
+    byte floor counts it even though the FLOP accounting doesn't)."""
+    assert cfg.mlp in ("gelu", "swiglu"), (
+        f"analytic train floor covers dense MLPs, got {cfg.mlp!r}"
+    )
+    d, c = cfg.n_embd, cfg.head_dim
+    f = _mlp_hidden(cfg)
+    qkv_out = (cfg.n_head + 2 * cfg.kv_heads) * c
+    per_layer = (
+        d * qkv_out + cfg.n_head * c * d
+        + (3 if cfg.mlp == "swiglu" else 2) * d * f
+    )
+    return cfg.n_layer * per_layer + 2 * cfg.vocab_size * d
+
+
+#: Bytes of HBM traffic one optimizer step moves per parameter under
+#: the donated f32-Adam step: f32 params read+written (8) + Adam m,v
+#: read+written (16) + the f32 grad written then read by the update (8)
+#: + the bf16 compute-cast copy written then re-read by the backward
+#: (4). Deliberately coarse (activations excluded — they are the
+#: compute side's concern) but stated, so the floor is reproducible
+#: arithmetic rather than folklore.
+TRAIN_STATE_BYTES_PER_PARAM = 36
+
+
+def train_floor_decomposition(
+    cfg,
+    *,
+    batch_size: int,
+    n_devices: int = 1,
+    flops_per_token: float,
+    peak_flops_per_device: float,
+    hbm_gbps: float = 800.0,
+    state_shards: tp.Optional[int] = None,
+) -> tp.Dict[str, tp.Any]:
+    """The static per-step roofline for one TRAINING geometry: the
+    compute floor (model FLOPs at the chip's peak — what MFU is
+    measured against) and the optimizer-state HBM floor
+    (:data:`TRAIN_STATE_BYTES_PER_PARAM` per parameter, sharded over
+    ``state_shards`` — defaults to ``n_devices``, the FSDP default),
+    combined as ``floor_ms_per_step = max(compute, hbm)``. The
+    attainment fraction a measured step carries is
+    ``floor_ms_per_step / measured_step_ms`` — 1.0 means the hardware
+    ceiling, and for the compute-bound training regime it tracks MFU by
+    construction. ``flops_per_token``/``peak_flops_per_device`` are
+    passed in so this stays jax-free (utils.metrics wires the
+    device-dependent values)."""
+    n_params = train_param_count(cfg)
+    shards = max(1, n_devices if state_shards is None else state_shards)
+    hbm_bytes = n_params * TRAIN_STATE_BYTES_PER_PARAM // shards
+    tokens_per_step = batch_size * cfg.block_size
+    compute_ms = (
+        tokens_per_step * flops_per_token
+        / (peak_flops_per_device * max(1, n_devices)) * 1e3
+    )
+    hbm_ms = hbm_bytes / (hbm_gbps * 1e9) * 1e3
+    return {
+        "n_params": n_params,
+        "tokens_per_step": tokens_per_step,
+        "hbm_gbps": hbm_gbps,
+        "train_state_bytes_per_step": hbm_bytes,
+        "train_compute_floor_ms": round(compute_ms, 4),
+        "train_hbm_floor_ms": round(hbm_ms, 4),
+        "train_floor_ms_per_step": round(max(compute_ms, hbm_ms), 4),
+        "train_floor_bound": (
+            "compute" if compute_ms >= hbm_ms else "hbm"
+        ),
     }
 
 

@@ -189,6 +189,15 @@ class ExperimentConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     use_wandb: bool = False  # wandb.init on proc 0 (parity: launch.py:68)
     debug: bool = False
+    # training-loop lifecycle tracing (midgpt_tpu.train_telemetry):
+    # prefetch-wait / window launch+harvest / eval / checkpoint events +
+    # Perfetto timeline + flight recorder, written into the rundir.
+    # Tracing is loop-side only — the jitted window program is the
+    # identical cached callable either way and the loss sequence is
+    # bitwise unchanged (tests/test_train_telemetry.py). The anomaly
+    # monitors run regardless of this flag (they only read scalars the
+    # logging path already pulled to the host).
+    train_telemetry: bool = False
 
     @property
     def microbatch_size(self) -> int:

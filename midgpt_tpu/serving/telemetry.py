@@ -5,8 +5,11 @@ The serving stack through PR 11 is feature-rich and machine-audited but
 blind at runtime: ``ServingEngine.stats()`` was a flat counter dict,
 per-request latency existed only as bench_serving's aggregate TTFT
 percentiles, and the two wedged hardware sessions (r4/r5) produced *no*
-timing data at all. This module is the observability substrate — four
-pieces, one design constraint:
+timing data at all. This module is the serving half of the observability
+substrate — the registry/event-ring/flight-recorder core now lives in
+:mod:`midgpt_tpu.telemetry` (shared with the training loop's
+:mod:`midgpt_tpu.train_telemetry`) and is re-exported here unchanged.
+Four pieces, one design constraint:
 
 1. **Per-request lifecycle tracing** (:class:`EngineTelemetry`): typed
    events — ``submit``, ``queued``, ``admitted``, ``prefill_chunk``,
@@ -28,7 +31,9 @@ pieces, one design constraint:
    over :class:`Counter` objects), so the registry is the single source
    and ``stats()`` is a stable façade over it — the exact key inventory
    is the :data:`ENGINE_STATS_KEYS`/:data:`CLUSTER_STATS_KEYS` contract,
-   pinned by test. ``snapshot()`` is JSON-exportable.
+   pinned by test. ``snapshot()`` is JSON-exportable, and
+   :func:`midgpt_tpu.telemetry.prometheus_text` renders it in Prometheus
+   text exposition format (``bench_serving --metrics_out``).
 
 3. **A flight recorder**: a bounded ring of recent events plus the last
    N dispatch records, dumped as a structured JSON artifact
@@ -65,11 +70,22 @@ per-token rate.
 
 from __future__ import annotations
 
-import collections
-import dataclasses
-import json
-import os
 import typing as tp
+
+from midgpt_tpu.telemetry import (  # noqa: F401 — the shared substrate,
+    # re-exported so every pre-split import path keeps working
+    Counter,
+    DispatchRecord,
+    Event,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    TelemetryLog,
+    percentile,
+    prometheus_text,
+    write_json,
+)
 
 __all__ = [
     "CLUSTER_STATS_KEYS",
@@ -144,161 +160,6 @@ CLUSTER_STATS_KEYS: tp.Tuple[str, ...] = ENGINE_STATS_KEYS + (
 
 
 # ---------------------------------------------------------------------------
-# Metrics registry
-# ---------------------------------------------------------------------------
-
-#: Fixed latency buckets (seconds) shared by every latency histogram:
-#: sub-ms through 10 s, roughly x2.5 per step. Fixed (not adaptive) so
-#: snapshots from different runs/replicas merge bucket-for-bucket.
-LATENCY_BUCKETS_S: tp.Tuple[float, ...] = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0,
-)
-
-
-class Counter:
-    """A monotone-by-convention integer metric. ``value`` is plainly
-    assignable (the bench's warmup reset relies on it)."""
-
-    __slots__ = ("name", "value")
-
-    def __init__(self, name: str, value: int = 0):
-        self.name = name
-        self.value = value
-
-    def inc(self, n: int = 1) -> None:
-        self.value += n
-
-
-class Gauge:
-    """A point-in-time reading: either ``set()`` explicitly or backed by
-    a zero-arg callback evaluated at snapshot time (the registry's way
-    of exporting live engine state — pool occupancy, queue depth —
-    without mirroring writes into the hot path)."""
-
-    __slots__ = ("name", "fn", "value")
-
-    def __init__(self, name: str, fn: tp.Optional[tp.Callable[[], float]] = None):
-        self.name = name
-        self.fn = fn
-        self.value: float = 0.0
-
-    def set(self, v: float) -> None:
-        self.value = v
-
-    def read(self) -> float:
-        return self.fn() if self.fn is not None else self.value
-
-
-class Histogram:
-    """A fixed-bucket histogram: ``counts[i]`` counts observations
-    ``<= bounds[i]``, with one overflow bucket at the end. Bounds are
-    immutable after construction so snapshots merge across replicas."""
-
-    __slots__ = ("name", "bounds", "counts", "total", "count")
-
-    def __init__(self, name: str, bounds: tp.Sequence[float] = LATENCY_BUCKETS_S):
-        assert list(bounds) == sorted(bounds), "bucket bounds must ascend"
-        self.name = name
-        self.bounds = tuple(float(b) for b in bounds)
-        self.counts = [0] * (len(self.bounds) + 1)
-        self.total = 0.0
-        self.count = 0
-
-    def observe(self, v: float) -> None:
-        i = 0
-        for b in self.bounds:
-            if v <= b:
-                break
-            i += 1
-        self.counts[i] += 1
-        self.total += v
-        self.count += 1
-
-    def reset(self) -> None:
-        self.counts = [0] * (len(self.bounds) + 1)
-        self.total = 0.0
-        self.count = 0
-
-    def to_dict(self) -> tp.Dict[str, tp.Any]:
-        return {
-            "buckets": list(self.bounds),
-            "counts": list(self.counts),
-            "count": self.count,
-            "sum": self.total,
-        }
-
-
-class MetricsRegistry:
-    """Counters + gauges + histograms under get-or-create names, with a
-    JSON-exportable :meth:`snapshot`. ``attach_labels`` registers a
-    labeled counter family *by reference* (e.g. the engine's
-    ``reject_reasons`` dict) so the owner keeps mutating its own dict
-    and the snapshot sees it live."""
-
-    def __init__(self) -> None:
-        self.counters: tp.Dict[str, Counter] = {}
-        self.gauges: tp.Dict[str, Gauge] = {}
-        self.histograms: tp.Dict[str, Histogram] = {}
-        self._labels: tp.Dict[str, tp.Dict[str, int]] = {}
-
-    def counter(self, name: str) -> Counter:
-        c = self.counters.get(name)
-        if c is None:
-            c = self.counters[name] = Counter(name)
-        return c
-
-    def gauge(
-        self, name: str, fn: tp.Optional[tp.Callable[[], float]] = None
-    ) -> Gauge:
-        g = self.gauges.get(name)
-        if g is None:
-            g = self.gauges[name] = Gauge(name, fn)
-        elif fn is not None:
-            g.fn = fn
-        return g
-
-    def histogram(
-        self, name: str, bounds: tp.Sequence[float] = LATENCY_BUCKETS_S
-    ) -> Histogram:
-        h = self.histograms.get(name)
-        if h is None:
-            h = self.histograms[name] = Histogram(name, bounds)
-        return h
-
-    def attach_labels(self, name: str, labels: tp.Dict[str, int]) -> None:
-        self._labels[name] = labels
-
-    def reset_histograms(self) -> None:
-        """Zero every histogram in place (bounds kept) — bench_serving's
-        post-warmup reset, next to the counter zeroing."""
-        for h in self.histograms.values():
-            h.reset()
-
-    def snapshot(self) -> tp.Dict[str, tp.Any]:
-        """One JSON-able view of everything: counters by value, gauges
-        evaluated now, histograms with bucket arrays, labeled families
-        copied. This is the superset ``stats()`` selects its façade
-        from."""
-        return {
-            "counters": {k: c.value for k, c in sorted(self.counters.items())},
-            "labeled": {k: dict(v) for k, v in sorted(self._labels.items())},
-            "gauges": {k: g.read() for k, g in sorted(self.gauges.items())},
-            "histograms": {
-                k: h.to_dict() for k, h in sorted(self.histograms.items())
-            },
-        }
-
-
-def percentile(sorted_vals: tp.Sequence[float], q: float) -> tp.Optional[float]:
-    """Nearest-rank percentile over an ascending list (None when empty)
-    — the same convention bench_serving's TTFT percentiles use."""
-    if not sorted_vals:
-        return None
-    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
-
-
-# ---------------------------------------------------------------------------
 # Events
 # ---------------------------------------------------------------------------
 
@@ -334,77 +195,15 @@ EVENT_KINDS: tp.Tuple[str, ...] = (
 )
 
 
-@dataclasses.dataclass
-class Event:
-    """One lifecycle event. ``step`` is the engine-local scheduler-step
-    counter (``engine.fault_step`` — the FaultPlan key space) and ``seq``
-    the per-telemetry emission index; both are replay-deterministic.
-    ``t`` is the engine clock's monotonic reading and is the ONLY
-    wall-clock field — ``data`` carries deterministic values (slots,
-    counts, reasons) exclusively, which is what makes
-    :meth:`EngineTelemetry.sequence_signature` exact across replays."""
-
-    seq: int
-    step: int
-    kind: str
-    rid: tp.Optional[int]
-    t: float
-    data: tp.Dict[str, tp.Any] = dataclasses.field(default_factory=dict)
-
-    def signature(self) -> tp.Tuple:
-        return (
-            self.seq, self.step, self.kind, self.rid,
-            tuple(sorted(self.data.items())),
-        )
-
-    def to_json(self) -> tp.Dict[str, tp.Any]:
-        return {
-            "seq": self.seq,
-            "step": self.step,
-            "kind": self.kind,
-            "rid": self.rid,
-            "t": self.t,
-            **self.data,
-        }
-
-
-@dataclasses.dataclass
-class DispatchRecord:
-    """One compiled-program launch, as the scheduler saw it: ``t`` is
-    the pre-dispatch clock reading and ``dur`` runs to the window's
-    existing device->host harvest read (decode/verify) or the program
-    call's return (prefill — an enqueue under async dispatch; exact on
-    the synchronous CPU test backend). No syncs are added either way."""
-
-    seq: int
-    step: int
-    kind: str  # decode_window | verify_dispatch | prefill_chunk
-    t: float
-    dur: float
-    rids: tp.Tuple[int, ...]
-    tokens: int
-    data: tp.Dict[str, tp.Any] = dataclasses.field(default_factory=dict)
-
-    def to_json(self) -> tp.Dict[str, tp.Any]:
-        return {
-            "seq": self.seq,
-            "step": self.step,
-            "kind": self.kind,
-            "t": self.t,
-            "dur": self.dur,
-            "rids": list(self.rids),
-            "tokens": self.tokens,
-            **self.data,
-        }
-
-
 # ---------------------------------------------------------------------------
 # EngineTelemetry
 # ---------------------------------------------------------------------------
 
 
-class EngineTelemetry:
-    """Per-engine event log + flight-recorder rings.
+class EngineTelemetry(TelemetryLog):
+    """Per-engine event log + flight-recorder rings (the serving
+    specialization of :class:`midgpt_tpu.telemetry.TelemetryLog`:
+    the serving lifecycle taxonomy plus derived per-request metrics).
 
     Two views of one stream: ``request_log`` keeps every event per
     request id (the timeline / derived-metrics view, bounded per
@@ -419,124 +218,7 @@ class EngineTelemetry:
     host-driven, with no effect on the compiled programs.
     """
 
-    def __init__(
-        self,
-        *,
-        ring: int = 4096,
-        dispatch_ring: int = 512,
-        per_request_cap: int = 4096,
-        profile_dir: tp.Optional[str] = None,
-        profile_steps: tp.Optional[tp.Tuple[int, int]] = None,
-    ):
-        assert ring >= 1 and dispatch_ring >= 1 and per_request_cap >= 1
-        if profile_steps is not None:
-            assert profile_dir is not None, "profile_steps needs profile_dir"
-            assert profile_steps[0] < profile_steps[1], profile_steps
-        self.ring_capacity = ring
-        self.dispatch_ring_capacity = dispatch_ring
-        self.per_request_cap = per_request_cap
-        self.profile_dir = profile_dir
-        self.profile_steps = profile_steps
-        self._profiling = False
-        self.events: tp.Deque[Event] = collections.deque(maxlen=ring)
-        self.dispatches: tp.Deque[DispatchRecord] = collections.deque(
-            maxlen=dispatch_ring
-        )
-        self.request_log: tp.Dict[int, tp.List[Event]] = {}
-        self._seq = 0
-
-    # -- recording ---------------------------------------------------------
-
-    def emit(
-        self,
-        kind: str,
-        *,
-        step: int,
-        t: float,
-        rid: tp.Optional[int] = None,
-        **data,
-    ) -> Event:
-        assert kind in EVENT_KINDS, kind
-        ev = Event(self._seq, step, kind, rid, t, data)
-        self._seq += 1
-        self.events.append(ev)
-        if rid is not None:
-            log = self.request_log.setdefault(rid, [])
-            if len(log) < self.per_request_cap:
-                log.append(ev)
-        return ev
-
-    def record_dispatch(
-        self,
-        kind: str,
-        *,
-        step: int,
-        t: float,
-        dur: float,
-        rids: tp.Sequence[int],
-        tokens: int,
-        **data,
-    ) -> DispatchRecord:
-        rec = DispatchRecord(
-            self._seq, step, kind, t, dur, tuple(rids), tokens, data
-        )
-        # dispatch records share the event seq space so the flight dump
-        # interleaves them unambiguously
-        self._seq += 1
-        self.dispatches.append(rec)
-        return rec
-
-    def reset(self) -> None:
-        """Drop everything recorded so far (bench_serving calls this
-        after warmup, next to re-arming the fault hooks, so the measured
-        trace's events start at seq 0 like its fault_steps do)."""
-        self.events.clear()
-        self.dispatches.clear()
-        self.request_log.clear()
-        self._seq = 0
-
-    # -- optional jax.profiler window --------------------------------------
-
-    def maybe_profile(self, step: int) -> None:
-        """Called by the engine at the top of each scheduler step (only
-        when telemetry is attached). Starts/stops a ``jax.profiler``
-        trace at the configured step boundaries; no-op without
-        ``profile_steps``."""
-        if self.profile_steps is None:
-            return
-        import jax
-
-        start, stop = self.profile_steps
-        if not self._profiling and step == start:
-            jax.profiler.start_trace(self.profile_dir)
-            self._profiling = True
-        elif self._profiling and step >= stop:
-            self.stop_profiling()
-
-    def stop_profiling(self) -> None:
-        """Stop an in-flight ``jax.profiler`` trace (idempotent). The
-        engine calls this when it drains, so a workload finishing
-        before the configured ``stop`` step still finalizes the trace
-        to ``profile_dir`` instead of leaving the profiler armed (a
-        dangling trace is unwritten AND makes the next ``start_trace``
-        in the process raise). Callers driving ``step()`` manually past
-        a drain should call it too."""
-        if not self._profiling:
-            return
-        import jax
-
-        jax.profiler.stop_trace()
-        self._profiling = False
-
-    # -- replay determinism -------------------------------------------------
-
-    def sequence_signature(self) -> tp.Tuple[tp.Tuple, ...]:
-        """The event stream minus wall-clock: what a chaos replay must
-        reproduce exactly (the FaultPlan convention — events are keyed
-        to scheduler steps, and every ``data`` field is deterministic
-        under the engine's replay contract). Ring-bounded: compare runs
-        whose event count fits ``ring``."""
-        return tuple(ev.signature() for ev in self.events)
+    event_kinds = EVENT_KINDS
 
     # -- derived per-request metrics ---------------------------------------
 
@@ -625,20 +307,6 @@ class EngineTelemetry:
             if m is not None and m["finished"]:
                 out.append(m)
         return out
-
-    # -- flight recorder ----------------------------------------------------
-
-    def flight_payload(self) -> tp.Dict[str, tp.Any]:
-        """The ring contents as JSON-able structures. Snapshot-copies
-        under the GIL, so it is safe to call from another thread
-        best-effort (the cluster's cold watchdog path — the wedged step
-        thread may still append, and a dump that misses its last event
-        beats no dump, which is the r4/r5 lesson this exists for)."""
-        return {
-            "ring_capacity": self.ring_capacity,
-            "events": [ev.to_json() for ev in list(self.events)],
-            "dispatches": [d.to_json() for d in list(self.dispatches)],
-        }
 
 
 # ---------------------------------------------------------------------------
@@ -771,14 +439,3 @@ def chrome_trace(tele: EngineTelemetry) -> tp.Dict[str, tp.Any]:
                          rids=list(d.rids)),
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
-
-
-def write_json(path: str, payload: tp.Dict[str, tp.Any]) -> str:
-    """Write a JSON artifact, creating parent directories; returns the
-    absolute path (what watchdog rows and flight dumps record
-    in-band)."""
-    path = os.path.abspath(path)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
-    return path

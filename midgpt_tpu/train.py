@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import os
 import time
 import typing as tp
@@ -43,7 +44,7 @@ from midgpt_tpu.parallel.sharding import (
     make_global_array,
 )
 from midgpt_tpu.pytree import cast_floating, module
-from midgpt_tpu.utils.metrics import MetricLogger, mfu
+from midgpt_tpu.utils.metrics import MetricLogger, mfu, train_floor
 
 Array = jax.Array
 
@@ -321,6 +322,62 @@ def make_train_window(
         return state, stacked  # each aux leaf stacked to [K]
 
     return jax.jit(window_fn, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Module-level window-program cache (the train-side inertness seam)
+# ---------------------------------------------------------------------------
+
+#: One jitted window program per (program-relevant config, mesh, K).
+#: Mirrors the serving engine's module-level program cache: telemetry,
+#: rundirs, logging cadence etc. are NOT part of the key, so two train
+#: drives differing only in observability knobs resolve to the
+#: ``is``-identical cached callable — which is how
+#: tests/test_train_telemetry.py proves tracing cannot perturb the
+#: dispatch pipeline (the serving inertness contract, mirrored).
+_WINDOW_PROGRAMS: tp.Dict[tp.Tuple, tp.Any] = {}
+
+#: ExperimentConfig fields that can NOT change the traced program:
+#: paths, run length, logging/eval/ckpt cadence, seeds (keys are entry
+#: arguments), and the observability knobs. Everything else — model,
+#: batch geometry, optimizer hyperparameters (traced into the update),
+#: dtypes, loss chunking, mesh config — is part of the key, and fields
+#: added to the config later are conservatively included by default.
+_NON_PROGRAM_FIELDS = (
+    "rundir", "data_dir", "max_steps", "eval_interval", "eval_batches",
+    "eval_fixed", "log_interval", "ckpt_interval", "ckpt_keep", "seed",
+    "data_seed", "use_wandb", "debug", "steps_per_dispatch",
+    "train_telemetry",
+)
+
+
+def _program_key(cfg: ExperimentConfig, mesh, k: int) -> tp.Tuple:
+    d = {
+        name: v for name, v in to_dict(cfg).items()
+        if name not in _NON_PROGRAM_FIELDS
+    }
+    return (
+        json.dumps(d, sort_keys=True),
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        tuple(dev.id for dev in mesh.devices.flat),
+        int(k),
+    )
+
+
+def get_train_window(cfg: ExperimentConfig, mesh, k: int):
+    """Memoized :func:`make_train_window`: one compile per (config
+    geometry, mesh, K). Builds its own optimizer chain from ``cfg``
+    (``make_optimizer`` — the only tx every in-repo caller uses), so a
+    cache hit is exactly the program a fresh trace would produce.
+    Callers with a custom ``tx`` must use :func:`make_train_window`
+    directly."""
+    key = _program_key(cfg, mesh, k)
+    prog = _WINDOW_PROGRAMS.get(key)
+    if prog is None:
+        tx, _ = make_optimizer(cfg)
+        prog = _WINDOW_PROGRAMS[key] = make_train_window(cfg, tx, mesh, k)
+    return prog
 
 
 def make_eval_step(cfg: ExperimentConfig, mesh):
@@ -634,12 +691,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         # state compiles one K-step program; an off-grid first/last window
         # compiles its own shorter one)
         train_step = make_train_step(cfg, tx, mesh) if k_disp == 1 else None
-        _window_progs: tp.Dict[int, tp.Any] = {}
 
         def _get_window_prog(kk: int):
-            if kk not in _window_progs:
-                _window_progs[kk] = make_train_window(cfg, tx, mesh, kk)
-            return _window_progs[kk]
+            # module-level cache: the program key excludes observability
+            # knobs (telemetry, rundir, logging cadence), so repeated
+            # drives share the identical jitted callable — and a remat
+            # step-down (which edits cfg.model) lands on a fresh key
+            # automatically
+            return get_train_window(cfg, mesh, kk)
 
         eval_step = make_eval_step(cfg, mesh)
 
@@ -751,9 +810,9 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 except Exception as e:  # noqa: BLE001 — filtered in helper
                     if not _try_remat_step_down(e, state):
                         raise
-                    # rebuilt lazily at the stepped-down remat; previously
-                    # warm lengths re-guard too (their programs changed)
-                    _window_progs.clear()
+                    # the stepped-down cfg.model lands on a fresh cache
+                    # key, so programs rebuild lazily; previously warm
+                    # lengths re-guard too (their programs changed)
                     _warm_window_lens.clear()
 
         ckpt = Checkpointer(
@@ -764,7 +823,76 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             ),
             async_save=not cfg.debug,
         )
-        logger = MetricLogger(cfg.rundir, cfg, use_wandb=cfg.use_wandb)
+        # roofline context (analysis/traffic.train_floor_decomposition via
+        # utils.metrics.train_floor): every logging step that carries
+        # tokens_per_sec also carries step_ms, the HBM/compute floors and
+        # attainment_frac = floor / measured — MFU's sibling, so the
+        # logged series is self-interpreting against the hardware ceiling
+        logger = MetricLogger(
+            cfg.rundir, cfg, use_wandb=cfg.use_wandb,
+            floor=train_floor(cfg, jax.device_count()),
+        )
+
+        # training-loop telemetry (midgpt_tpu.train_telemetry): lifecycle
+        # tracing is opt-in (cfg.train_telemetry) and proc-0 only; the
+        # anomaly monitors are ALWAYS on — they consume only scalars the
+        # logging path already pulled to the host. Tracing is loop-side
+        # exclusively: the jitted window resolves through
+        # get_train_window's module-level cache, whose key excludes every
+        # observability knob, so telemetry on/off selects the identical
+        # cached callable (tests/test_train_telemetry.py).
+        from midgpt_tpu.train_telemetry import (
+            AnomalyMonitors,
+            TrainTelemetry,
+            chrome_trace_train,
+        )
+
+        _local_rundir = (
+            cfg.rundir
+            if cfg.rundir and not cfg.rundir.startswith("gs://")
+            else None
+        )
+        tele = (
+            TrainTelemetry() if cfg.train_telemetry and proc == 0 else None
+        )
+        monitors = AnomalyMonitors(
+            telemetry=tele,
+            flight_dir=_local_rundir if proc == 0 else None,
+        )
+        if tele is not None:
+            tele.emit("run_start", step=0, t=time.perf_counter())
+
+        def _report_trips(trips, metrics, step) -> None:
+            """Shared trip reporting for the window and K=1 logging
+            paths: flag the step's metrics row + proc-0 stderr-visible
+            print (the monitors never raise — observe, don't decide)."""
+            for trip in trips:
+                metrics[f"anomaly/{trip['kind']}"] = 1.0
+                if proc == 0:
+                    print(
+                        f"ANOMALY {trip['kind']} at step {step}: "
+                        f"{trip['detail']}"
+                    )
+
+        def _finalize_tele(last_step: int) -> None:
+            final["anomalies"] = len(monitors.trips)
+            if tele is None:
+                return
+            tele.emit("run_end", step=last_step, t=time.perf_counter())
+            if _local_rundir is not None:
+                from midgpt_tpu.telemetry import write_json
+
+                write_json(
+                    os.path.join(_local_rundir, "train_timeline.json"),
+                    chrome_trace_train(tele),
+                )
+                tele.flight_dump(
+                    "run_end",
+                    path=os.path.join(
+                        _local_rundir, "train_telemetry.json"
+                    ),
+                )
+
         if ckpt.latest_step() is not None:
             # adapt to the checkpoint's actual MLP width BEFORE building any
             # state: configs with mlp_hidden=None saved under the old
@@ -841,6 +969,8 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             )
             train_loader.load_state_dict(meta["loader"])
             first_step = int(meta["step"]) + 1
+            if tele is not None:
+                tele.emit("resume", step=first_step, t=time.perf_counter())
             if proc == 0:
                 print(f"resumed from step {meta['step']}")
 
@@ -903,6 +1033,9 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 if w_start % cfg.eval_interval == 0 or w_start == first_step:
                     n_eval = 1 if cfg.debug else cfg.eval_batches
                     eoff = 0 if cfg.eval_fixed else w_start
+                    # evaluate() ends in a float() host read either way —
+                    # the span's clock stamps add no sync
+                    t_ev = time.perf_counter()
                     train_loss = evaluate(
                         eval_step, state.params, train_eval_loader, mesh,
                         n_eval, eoff,
@@ -911,6 +1044,13 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                         eval_step, state.params, val_loader, mesh, n_eval,
                         eoff,
                     )
+                    if tele is not None:
+                        tele.metrics.counter("evals").inc()
+                        tele.span(
+                            "eval_pause", step=w_start, t=t_ev,
+                            dur=time.perf_counter() - t_ev,
+                            batches=n_eval,
+                        )
                     logger.log(
                         w_start,
                         {
@@ -923,7 +1063,20 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                         {"train_loss": train_loss, "val_loss": val_loss}
                     )
 
+                # prefetch.next() is the loop's existing host block on the
+                # loader queue; timing it classifies who owned the wait
+                t_pf = time.perf_counter()
                 xs, ys = prefetch.next()  # [k_eff, G, B, T] global arrays
+                t_launch = time.perf_counter()
+                if tele is not None:
+                    tele.prefetch_wait(
+                        step=w_start, t=t_pf, dur=t_launch - t_pf
+                    )
+                    tele.emit(
+                        "window_launch", step=w_start, t=t_launch, k=k_eff
+                    )
+                    tele.metrics.counter("windows_dispatched").inc()
+                    tele.metrics.counter("steps_completed").inc(k_eff)
                 if (
                     cfg.debug and wi == 1
                     and not cfg.rundir.startswith("gs://")
@@ -954,6 +1107,18 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     lrs_h = np.asarray(wout["lr"])
                     gnorms_h = np.asarray(wout["grad_norm"])
                     now = time.time()
+                    # THE existing device->host harvest read: the only
+                    # place window wall time legitimately exists
+                    t_harvest = time.perf_counter()
+                    if tele is not None:
+                        tele.emit(
+                            "window_harvest", step=w_end, t=t_harvest,
+                            k=k_eff,
+                        )
+                        tele.span(
+                            "train_window", step=w_start, t=t_launch,
+                            dur=t_harvest - t_launch, k=k_eff,
+                        )
                     for s in log_steps:
                         i = s - w_start
                         loss_v = float(losses_h[i])
@@ -962,6 +1127,12 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                             "lr": float(lrs_h[i]),
                             "grad_norm": float(gnorms_h[i]),
                         }
+                        _report_trips(
+                            monitors.observe_step(
+                                s, loss_v, float(gnorms_h[i]), t=t_harvest
+                            ),
+                            metrics, s,
+                        )
                         if s == log_steps[-1]:
                             # throughput is host-clocked: it exists at
                             # window, not step, granularity
@@ -977,6 +1148,12 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                             )
                             final["tokens_per_sec"] = tps
                             final["mfu"] = metrics["mfu"]
+                            _report_trips(
+                                monitors.observe_throughput(
+                                    s, tps, t=t_harvest
+                                ),
+                                metrics, s,
+                            )
                         logger.log(s, metrics)
                         final["loss"] = loss_v
                     if wbar is not None and hasattr(wbar, "set_postfix"):
@@ -993,6 +1170,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     # forced through the manager. A SIGTERM force-save
                     # lands on the completed window: an exact step
                     # boundary, so resume replays nothing partially.
+                    t_ck = time.perf_counter()
                     ckpt.save(
                         w_end,
                         _ckpt_items(state),
@@ -1004,7 +1182,20 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                         },
                         force=True,
                     )
+                    if tele is not None:
+                        # async_save: dur covers the enqueue (exact only
+                        # in cfg.debug's synchronous mode); the flush
+                        # wait lands on the ckpt_wait span at close
+                        tele.metrics.counter("ckpt_saves").inc()
+                        tele.span(
+                            "ckpt_save", step=w_end, t=t_ck,
+                            dur=time.perf_counter() - t_ck,
+                        )
                 if stop_requested["flag"]:
+                    if tele is not None:
+                        tele.emit(
+                            "interrupt", step=w_end, t=time.perf_counter()
+                        )
                     if proc == 0:
                         print(f"SIGTERM: checkpointed step {w_end}, exiting")
                     final["interrupted_at"] = w_end
@@ -1038,10 +1229,17 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             if itr % cfg.eval_interval == 0 or itr == first_step:
                 n_eval = 1 if cfg.debug else cfg.eval_batches
                 eoff = 0 if cfg.eval_fixed else itr
+                t_ev = time.perf_counter()
                 train_loss = evaluate(
                     eval_step, state.params, train_eval_loader, mesh, n_eval, eoff
                 )
                 val_loss = evaluate(eval_step, state.params, val_loader, mesh, n_eval, eoff)
+                if tele is not None:
+                    tele.metrics.counter("evals").inc()
+                    tele.span(
+                        "eval_pause", step=itr, t=t_ev,
+                        dur=time.perf_counter() - t_ev, batches=n_eval,
+                    )
                 logger.log(
                     itr,
                     {
@@ -1052,7 +1250,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 )
                 final.update({"train_loss": train_loss, "val_loss": val_loss})
 
+            t_pf = time.perf_counter()
             xg, yg = prefetch.next()
+            t_launch = time.perf_counter()
+            if tele is not None:
+                tele.prefetch_wait(step=itr, t=t_pf, dur=t_launch - t_pf)
+                tele.emit("window_launch", step=itr, t=t_launch, k=1)
+                tele.metrics.counter("windows_dispatched").inc()
+                tele.metrics.counter("steps_completed").inc()
             step_key = jax.random.fold_in(key, itr)
 
             if cfg.debug and itr == first_step + 1 and not cfg.rundir.startswith("gs://"):
@@ -1065,7 +1270,8 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             dispatch_count += 1
 
             if itr % cfg.log_interval == 0 and itr > 0:
-                loss_v = float(loss)
+                loss_v = float(loss)  # THE existing host read (K=1 path)
+                t_harvest = time.perf_counter()
                 now = time.time()
                 tps = tokens_per_step * (itr - last_log_step) / max(now - last_log_time, 1e-9)
                 last_log_time, last_log_step = now, itr
@@ -1075,6 +1281,19 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                     "tokens_per_sec": tps,
                     "mfu": mfu(tps, cfg.model, jax.device_count()),
                 }
+                if tele is not None:
+                    tele.emit("window_harvest", step=itr, t=t_harvest, k=1)
+                    tele.span(
+                        "train_window", step=itr, t=t_launch,
+                        dur=t_harvest - t_launch, k=1,
+                    )
+                # the K=1 path logs no grad_norm (it rides the window
+                # scan outputs only) — the monitors skip that detector
+                _report_trips(
+                    monitors.observe_step(itr, loss_v, None, t=t_harvest)
+                    + monitors.observe_throughput(itr, tps, t=t_harvest),
+                    metrics, itr,
+                )
                 logger.log(itr, metrics)
                 if hasattr(pbar, "set_postfix"):
                     pbar.set_postfix(
@@ -1103,6 +1322,8 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 )
 
             if stop_requested["flag"]:
+                if tele is not None:
+                    tele.emit("interrupt", step=itr, t=time.perf_counter())
                 if proc == 0:
                     print(f"SIGTERM: checkpointed step {itr}, exiting")
                 final["interrupted_at"] = itr
@@ -1115,7 +1336,14 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
         if "interrupted_at" in final:
             # preempted: the in-loop force-save owns the last completed step;
             # a max_steps-1 save here would mislabel partial progress
-            ckpt.close()
+            t_cw = time.perf_counter()
+            ckpt.close()  # async-save flush: the real checkpoint wait
+            if tele is not None:
+                tele.span(
+                    "ckpt_wait", step=int(final["interrupted_at"]),
+                    t=t_cw, dur=time.perf_counter() - t_cw,
+                )
+            _finalize_tele(int(final["interrupted_at"]))
             logger.close()
             return final
 
@@ -1132,6 +1360,7 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
             and cfg.max_steps > first_step
             and ckpt.latest_step() != cfg.max_steps - 1  # in-loop save may own it
         ):
+            t_ck = time.perf_counter()
             ckpt.save(
                 cfg.max_steps - 1,
                 _ckpt_items(state),
@@ -1143,7 +1372,20 @@ def train(cfg: ExperimentConfig) -> tp.Dict[str, float]:
                 },
                 force=True,
             )
-        ckpt.close()
+            if tele is not None:
+                tele.metrics.counter("ckpt_saves").inc()
+                tele.span(
+                    "ckpt_save", step=cfg.max_steps - 1, t=t_ck,
+                    dur=time.perf_counter() - t_ck,
+                )
+        t_cw = time.perf_counter()
+        ckpt.close()  # async-save flush: the real checkpoint wait
+        if tele is not None:
+            tele.span(
+                "ckpt_wait", step=cfg.max_steps, t=t_cw,
+                dur=time.perf_counter() - t_cw,
+            )
+        _finalize_tele(cfg.max_steps)
         logger.close()
         return final
     finally:

@@ -65,6 +65,53 @@ def mfu(tokens_per_sec: float, model: ModelConfig, n_devices: int) -> float:
     return achieved / peak
 
 
+def decode_flops_per_token(
+    model: ModelConfig, live_tokens: tp.Optional[float] = None
+) -> float:
+    """Inference FLOPs per generated token (forward only): 2 FLOPs per
+    parameter MAC plus the attention score/value term over the live KV
+    context — the numerator of serving MFU, which bench_serving records
+    next to the HBM-floor attainment so the compute-vs-bandwidth split
+    of a decode step is visible in one row."""
+    live = float(model.block_size if live_tokens is None else live_tokens)
+    d = model.n_embd
+    c = model.head_dim
+    from midgpt_tpu.models.gpt import mlp_hidden_dim
+
+    f = mlp_hidden_dim(model)
+    qkv = d * (model.n_head + 2 * model.kv_heads) * c
+    proj = model.n_head * c * d
+    mlp = (3 if model.mlp == "swiglu" else 2) * d * f
+    n_matmul = model.n_layer * (qkv + proj + mlp) + d * model.vocab_size
+    # scores + value sum: 2 matmuls of live x C per head, 2 FLOPs/MAC
+    attn = 4 * model.n_layer * model.n_head * c * live
+    return 2 * n_matmul + attn
+
+
+def train_floor(
+    cfg: ExperimentConfig, n_devices: int
+) -> tp.Optional[tp.Dict[str, tp.Any]]:
+    """The training-step roofline context MetricLogger attaches to every
+    logging step (analysis/traffic.train_floor_decomposition, wired to
+    this device's peak FLOPs): compute + HBM floors and the
+    tokens-per-step needed to turn a measured tokens_per_sec into
+    step_ms and an attainment fraction. None when the analytic floor
+    doesn't cover the config (e.g. MoE) — logging then proceeds without
+    the attainment keys rather than with wrong ones."""
+    from midgpt_tpu.analysis.traffic import train_floor_decomposition
+
+    try:
+        return train_floor_decomposition(
+            cfg.model,
+            batch_size=cfg.batch_size,
+            n_devices=n_devices,
+            flops_per_token=flops_per_token(cfg.model),
+            peak_flops_per_device=device_peak_flops(),
+        )
+    except AssertionError:
+        return None
+
+
 def moe_router_metrics(stats: tp.Mapping[str, tp.Any]) -> tp.Dict[str, float]:
     """Schema for the per-eval-interval MoE router telemetry (VERDICT r5
     Next #7): ``moe/aux`` (load-balance aux, 1.0 = perfectly balanced,
@@ -102,10 +149,23 @@ def _load_or_create_wandb_id(rundir: str, wandb_mod) -> tp.Optional[str]:
 
 class MetricLogger:
     """JSONL metrics + optional wandb, process-0 only (parity:
-    launch.py:38-68 / train.py:212-213 wandb logging)."""
+    launch.py:38-68 / train.py:212-213 wandb logging).
 
-    def __init__(self, rundir: str, config: ExperimentConfig, use_wandb: bool = False):
+    ``floor`` (a ``train_floor`` dict) arms roofline attainment: any
+    logged metrics dict carrying ``tokens_per_sec`` is augmented with
+    ``step_ms`` (tokens_per_step / rate), the static
+    ``train_hbm_floor_ms`` / ``train_compute_floor_ms`` decomposition,
+    and ``train_attainment_frac = floor / measured`` — so the logged
+    series reads against the hardware ceiling next to MFU instead of
+    requiring hand arithmetic in PERF.md."""
+
+    def __init__(
+        self, rundir: str, config: ExperimentConfig,
+        use_wandb: bool = False,
+        floor: tp.Optional[tp.Mapping[str, tp.Any]] = None,
+    ):
         self.is_main = jax.process_index() == 0
+        self.floor = floor
         self._file = None
         self._wandb = None
         if not self.is_main:
@@ -131,9 +191,34 @@ class MetricLogger:
             except Exception:
                 self._wandb = None
 
+    def attainment(
+        self, tokens_per_sec: float
+    ) -> tp.Dict[str, float]:
+        """The roofline keys for one measured rate (empty without a
+        floor context): measured step_ms, the two static floors, and
+        attainment = floor / measured."""
+        fl = self.floor
+        if not fl or tokens_per_sec <= 0:
+            return {}
+        step_ms = fl["tokens_per_step"] / tokens_per_sec * 1e3
+        return {
+            "step_ms": round(step_ms, 3),
+            "train_hbm_floor_ms": fl["train_hbm_floor_ms"],
+            "train_compute_floor_ms": fl["train_compute_floor_ms"],
+            # significant digits, not decimals: CPU attainment is ~1e-8
+            # and must not round to a hard zero
+            "train_attainment_frac": float(
+                f"{fl['train_floor_ms_per_step'] / step_ms:.3g}"
+            ),
+        }
+
     def log(self, step: int, metrics: tp.Mapping[str, float]) -> None:
         if not self.is_main:
             return
+        if "tokens_per_sec" in metrics:
+            metrics = {
+                **metrics, **self.attainment(metrics["tokens_per_sec"])
+            }
         rec = {"step": step, "time": time.time(), **metrics}
         if self._file is not None:
             self._file.write(json.dumps(rec) + "\n")

@@ -256,6 +256,12 @@ def main() -> None:
                     "identical on/off; measured overhead is the "
                     "host-side scheduler only — PERF.md) — 'off' exists "
                     "to ladder exactly that claim on hardware")
+    ap.add_argument("--metrics_out", default=None,
+                    help="write the metrics-registry snapshot (engine "
+                    "or cluster + per-replica) in Prometheus text "
+                    "exposition format to this path "
+                    "(midgpt_tpu.telemetry.prometheus_text) — the "
+                    "pull-scrape view of metrics_snapshot.json")
     ap.add_argument("--timeline_dir", default=None,
                     help="write per-replica Chrome trace-event timelines "
                     "(openable in Perfetto), the per-request derived "
@@ -617,6 +623,9 @@ def main() -> None:
 
         from midgpt_tpu.serving import AsyncFrontDoor
 
+        streams: dict = {}  # request index -> TokenStream (the tenant
+        # breakdown below needs the per-request terminal outcome)
+
         async def _drive_trace():
             fd = AsyncFrontDoor(eng)
             consumers = []
@@ -647,6 +656,7 @@ def main() -> None:
                             else start + arrivals[i] + deadlines_s[i]
                         ),
                     )
+                    streams[i] = stream
                     consumers.append(
                         asyncio.create_task(consume(i, stream))
                     )
@@ -810,6 +820,69 @@ def main() -> None:
     )
     st = eng.stats()
 
+    # measured-vs-floor attainment + serving MFU (the r6 rungs land
+    # self-interpreting): ms/tok measured over the trace vs the static
+    # per-token HBM floor above, and the achieved fraction of peak
+    # FLOPs at the decode forward's per-token FLOP count — bandwidth
+    # and compute ceilings side by side in one row.
+    from midgpt_tpu.utils.metrics import (
+        decode_flops_per_token,
+        device_peak_flops,
+    )
+
+    ms_per_tok = (
+        wall * 1e3 / st["tokens_generated"]
+        if st["tokens_generated"] else None
+    )
+    n_chips = max(1, args.tp * args.dp_replicas)
+    serve_mfu_v = (
+        round(
+            (st["tokens_generated"] / wall)
+            * decode_flops_per_token(cfg, live_mean)
+            / (device_peak_flops() * n_chips), 6,
+        )
+        if wall > 0 else None
+    )
+
+    # per-tenant SLO/goodput breakdown (--trace + --tenants): the zipf
+    # tenant mix becomes observable per tenant — which tenants' tokens
+    # banked within deadline, not just the aggregate
+    tenant_requests = tenant_goodput = tenant_met = None
+    if args.trace != "off" and tenant_of is not None:
+        tenant_requests = {str(t): 0 for t in range(args.tenants)}
+        tenant_met = {str(t): 0 for t in range(args.tenants)}
+        _tenant_toks = {str(t): 0 for t in range(args.tenants)}
+        for i, s_ in streams.items():
+            tkey = str(int(tenant_of[i]))
+            tenant_requests[tkey] += 1
+            req = s_.request
+            if s_.outcome == "finished" and req is not None and (
+                req.deadline is None
+                or (
+                    req.finish_time is not None
+                    and req.finish_time <= req.deadline
+                )
+            ):
+                tenant_met[tkey] += 1
+                _tenant_toks[tkey] += len(req.tokens)
+        tenant_goodput = {
+            t: round(n / wall, 1) for t, n in _tenant_toks.items()
+        }
+
+    # Prometheus text exposition over the metrics registry (engine or
+    # cluster + replicas) — the scrape-format twin of the
+    # metrics_snapshot.json artifact
+    metrics_out_path = None
+    if args.metrics_out:
+        from midgpt_tpu.telemetry import prometheus_text
+
+        metrics_out_path = os.path.abspath(args.metrics_out)
+        os.makedirs(
+            os.path.dirname(metrics_out_path) or ".", exist_ok=True
+        )
+        with open(metrics_out_path, "w") as f:
+            f.write(prometheus_text(eng.metrics_snapshot()))
+
     # telemetry-derived per-request latency percentiles + timeline
     # artifacts (serving.telemetry). TBT granularity honesty: the
     # engine emits tokens in window batches, so the per-token gaps are
@@ -909,6 +982,21 @@ def main() -> None:
         ],
         "serve_kv_bytes_per_step_static": static["kv_bytes_per_step"],
         "serve_hbm_floor_ms_static": static["floor_ms_per_step"],
+        "serve_floor_ms_per_tok_static": static["floor_ms_per_token"],
+        "serve_ms_per_tok": (
+            round(ms_per_tok, 4) if ms_per_tok is not None else None
+        ),
+        # attainment = floor / measured: 1.0 means the decode step runs
+        # at the HBM roofline; the residual is dispatch structure +
+        # [B,1,D] matmul inefficiency (PERF.md's gap decomposition,
+        # now measured in-band instead of hand-derived)
+        "serve_attainment_frac": (
+            # significant digits, not decimals: tiny-preset CPU rows sit
+            # at ~1e-4 and must not round to a hard zero
+            float(f"{static['floor_ms_per_token'] / ms_per_tok:.3g}")
+            if ms_per_tok else None
+        ),
+        "serve_mfu": serve_mfu_v,
         "serve_static_live_tokens": round(live_mean, 1),
         "serve_requests": args.requests,
         "serve_rate_req_s": args.rate if args.preset != "tiny" else None,
@@ -949,6 +1037,10 @@ def main() -> None:
         "serve_priority_levels": args.priority_levels,
         "serve_cancel_frac": args.cancel_frac,
         "serve_tenants": args.tenants or None,
+        "serve_tenant_requests": tenant_requests,
+        "serve_tenant_goodput": tenant_goodput,
+        "serve_tenant_deadline_met": tenant_met,
+        "serve_metrics_out": metrics_out_path,
         "serve_goodput_slo_tok_s": round(slo_tokens / wall, 1),
         "serve_deadline_met": len(met),
         "serve_deadline_missed": n_missed,

@@ -226,4 +226,21 @@ try:
 except Exception as e:
     print("moe probe FAILED:", repr(e)[:300])
 PYEOF
+# perf-trajectory ledger over this round's records (analysis/ledger.py,
+# PR 15): gate EVERY rung row that landed under artifacts/r6 against
+# the BENCH_r*.json trajectory (each row passed via --record — records
+# ingested only as --records-dir would join the reference side and the
+# self-check mode gates just the newest one). Hardware rows, so
+# wall-clock bands gate HARD; the report rides next to the rung
+# records. Non-fatal to the queue (the rows are already on disk either
+# way) but the rc lands in the log so the driver sees a regression
+# verdict in-band.
+LEDGER_RECORDS=""
+for f in artifacts/r6/*.json; do
+  [ -f "$f" ] && LEDGER_RECORDS="$LEDGER_RECORDS --record $f"
+done
+run perf_ledger python -m midgpt_tpu.analysis --ledger \
+    $LEDGER_RECORDS --hardware on \
+    --report artifacts/r6/ledger_report.md
+
 echo "[queue] $(date -u +%H:%M:%S) ALL DONE" >> "$LOG/queue.log"

@@ -115,6 +115,32 @@ def pytest_deselected(items):
 
 
 def pytest_terminal_summary(terminalreporter):
+    # SUITE_TIMING_OUT=path: also write the accounting as a JSON
+    # artifact (CI uploads it; analysis/ledger.py ingests it via
+    # --suite-timing, so tier-1 wall-time drift is tracked in the
+    # perf trajectory like any other metric)
+    out = os.environ.get("SUITE_TIMING_OUT")
+    if out:
+        import json
+
+        top = sorted(_CALL_DURATIONS, reverse=True)[:10]
+        payload = {
+            "kind": "suite",
+            "suite_total_call_s": round(
+                sum(d for d, _ in _CALL_DURATIONS), 2
+            ),
+            "suite_n_calls": len(_CALL_DURATIONS),
+            "slowest": [
+                {"nodeid": nodeid, "s": round(dur, 2)}
+                for dur, nodeid in top
+            ],
+            "deselected_slow": dict(sorted(_DESELECTED_SLOW.items())),
+        }
+        os.makedirs(
+            os.path.dirname(os.path.abspath(out)), exist_ok=True
+        )
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
     if _DESELECTED_SLOW:
         total_slow = sum(_DESELECTED_SLOW.values())
         terminalreporter.write_sep(

@@ -134,8 +134,13 @@ def test_bench_main_record_flow_with_stubbed_rungs(monkeypatch, capsys):
                         loss_chunk=256, block_size=None):
         cfg = types.SimpleNamespace(
             batch_size=batch,
+            # a full dense-model shape: the attainment helper computes
+            # the analytic train floor from these fields (traffic.py)
             model=types.SimpleNamespace(
-                block_size=block_size or 64, remat=remat
+                block_size=block_size or 64, remat=remat,
+                mlp="gelu", mlp_hidden=None, mlp_ratio=4,
+                n_embd=64, head_dim=16, n_head=4, kv_heads=4,
+                n_layer=n_layer or 2, vocab_size=256, qk_norm=False,
             ),
         )
 
@@ -174,3 +179,48 @@ def test_bench_main_record_flow_with_stubbed_rungs(monkeypatch, capsys):
     assert "long_ctx_mfu" in rec
     assert rec["measure"] == "chained"
     assert rec["status"] == "ok"
+    # PR 15 contract: the headline + gpt2s rungs carry the static
+    # roofline floors and attainment next to their MFU (the ledger's
+    # static-key gating and the "self-interpreting r6 rows" promise
+    # both read these by name)
+    for prefix in ("", "gpt2s_"):
+        assert rec[prefix + "train_compute_floor_ms"] > 0
+        assert rec[prefix + "train_hbm_floor_ms"] > 0
+        assert rec[prefix + "train_attainment_frac"] > 0
+
+
+def test_emit_bench_error_carries_flight_dump_in_band(tmp_path, capsys):
+    """Watchdog/error rows carry the rung-lifecycle flight-dump path
+    in-band when telemetry is armed — the r4/r5 wedged-run lesson
+    applied to the training bench (bench_serving's rows already do
+    this)."""
+    sys.path.insert(0, REPO)
+    import bench
+    from midgpt_tpu.train_telemetry import TrainTelemetry
+
+    tele = TrainTelemetry()
+    tele.emit("run_start", step=0, t=0.0)
+    tele.emit("rung_start", step=1, t=1.0, rung="xl_L8_B12")
+    old = dict(bench._FLIGHT)
+    try:
+        bench._FLIGHT.update(tele=tele, dir=str(tmp_path))
+        bench._emit_bench_error("relay wedged", status="watchdog")
+    finally:
+        bench._FLIGHT.update(old)
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["metric"] == "bench_error"
+    assert rec["status"] == "watchdog"
+    assert rec["flight_recorder"], "dump path must ride in-band"
+    dump = json.load(open(rec["flight_recorder"][0]))
+    assert dump["reason"] == "bench:watchdog"
+    assert [e["kind"] for e in dump["telemetry"]["events"]] == [
+        "run_start", "rung_start",
+    ]
+    # without telemetry the row stays a bare (but valid) error record
+    try:
+        bench._FLIGHT.update(tele=None, dir=None)
+        bench._emit_bench_error("boom")
+    finally:
+        bench._FLIGHT.update(old)
+    rec2 = json.loads(capsys.readouterr().out.strip())
+    assert "flight_recorder" not in rec2

@@ -483,12 +483,13 @@ def test_bench_serving_telemetry_record_contract(tmp_path):
         os.environ, JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=2",
     )
+    prom = str(tmp_path / "metrics.prom")
     r = subprocess.run(
         [sys.executable, os.path.join(repo, "scripts", "bench_serving.py"),
          "--preset", "tiny", "--dp_replicas", "2",
          "--fault_plan", "1:transient@0;2:crash@0",
          "--dispatch_timeout_s", "60", "--deadline_s", "600",
-         "--timeline_dir", tl, "--out", out],
+         "--timeline_dir", tl, "--metrics_out", prom, "--out", out],
         capture_output=True, text=True, env=env, timeout=540,
     )
     assert r.returncode == 0, r.stderr[-2000:]
@@ -497,6 +498,25 @@ def test_bench_serving_telemetry_record_contract(tmp_path):
     assert rec["serve_telemetry"] == "on"
     assert rec["serve_tbt_p99_ms"] is not None
     assert rec["serve_queue_delay_p50_ms"] is not None
+    # floor + attainment + MFU ride every record (PR 15 contract): the
+    # static per-token floor, the measured ms/tok, their ratio, and the
+    # compute-side fraction — the ledger's static/wall-clock key split
+    # depends on this inventory
+    assert rec["serve_floor_ms_per_tok_static"] > 0
+    assert rec["serve_ms_per_tok"] > 0
+    assert rec["serve_attainment_frac"] == pytest.approx(
+        rec["serve_floor_ms_per_tok_static"] / rec["serve_ms_per_tok"],
+        rel=1e-2,
+    )
+    assert rec["serve_mfu"] is not None and rec["serve_mfu"] > 0
+    assert rec["serve_hbm_floor_ms_static"] > 0
+    # --metrics_out: Prometheus text exposition over the cluster
+    # registry, path recorded in-band
+    assert rec["serve_metrics_out"] == prom
+    text = open(prom).read()
+    assert "# TYPE midgpt_tokens_generated_total counter" in text
+    assert 'replica="0"' in text and 'replica="1"' in text
+    assert 'scope="cluster"' in text
     assert rec["serve_requests_finished"] == rec["serve_requests"]
     for f in rec["serve_timeline_files"]:
         assert os.path.exists(f), f
@@ -509,3 +529,98 @@ def test_bench_serving_telemetry_record_contract(tmp_path):
     # the timeline is a loadable Chrome trace
     tr = json.load(open(os.path.join(tl, "timeline_replica0.json")))
     assert tr["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# Shared substrate (PR 15): serving re-exports the midgpt_tpu.telemetry
+# core unchanged, and the Prometheus exporter renders registry
+# snapshots against the pinned stats-key contracts
+# ---------------------------------------------------------------------------
+
+
+def test_serving_reexports_shared_substrate():
+    """The PR 15 extraction contract: every substrate name the serving
+    module exposed before the split must still resolve to the SAME
+    object through midgpt_tpu.serving.telemetry (engine/cluster/bench
+    imports keep working verbatim), and EngineTelemetry is the
+    serving-taxonomy specialization of the shared TelemetryLog."""
+    import midgpt_tpu.serving.telemetry as serving_tele
+    import midgpt_tpu.telemetry as core
+    from midgpt_tpu.telemetry import TelemetryLog
+
+    for name in (
+        "Counter", "Gauge", "Histogram", "MetricsRegistry", "Event",
+        "DispatchRecord", "percentile", "write_json",
+        "LATENCY_BUCKETS_S", "prometheus_text",
+    ):
+        assert getattr(serving_tele, name) is getattr(core, name), name
+    assert issubclass(EngineTelemetry, TelemetryLog)
+    assert EngineTelemetry.event_kinds == EVENT_KINDS
+    # the base rejects kinds outside the subclass taxonomy
+    t = EngineTelemetry()
+    with pytest.raises(AssertionError):
+        t.emit("window_launch", step=0, t=0.0)
+
+
+def test_prometheus_text_format_units():
+    """Exposition-format details the scrape side depends on: counters
+    get _total, labeled families one series per key, histograms render
+    CUMULATIVE buckets + +Inf + _sum/_count, labels merge, and each
+    family gets exactly one # TYPE header even across snapshots."""
+    from midgpt_tpu.telemetry import prometheus_text
+
+    reg = MetricsRegistry()
+    reg.counter("hits").inc(3)
+    reg.attach_labels("reasons", {"full": 2})
+    reg.gauge("depth").set(1.5)
+    h = reg.histogram("lat", bounds=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    reg2 = MetricsRegistry()
+    reg2.counter("hits").inc(7)
+    text = prometheus_text([
+        ({"replica": "0"}, reg.snapshot()),
+        ({"replica": "1"}, reg2.snapshot()),
+    ])
+    assert 'midgpt_hits_total{replica="0"} 3' in text
+    assert 'midgpt_hits_total{replica="1"} 7' in text
+    assert 'midgpt_reasons_total{key="full",replica="0"} 2' in text
+    assert 'midgpt_depth{replica="0"} 1.5' in text
+    assert 'midgpt_lat_bucket{le="0.1",replica="0"} 1' in text
+    # cumulative: the 1.0 bucket includes the 0.1 bucket's observation
+    assert 'midgpt_lat_bucket{le="1.0",replica="0"} 1' in text
+    assert 'midgpt_lat_bucket{le="+Inf",replica="0"} 2' in text
+    assert 'midgpt_lat_count{replica="0"} 2' in text
+    assert text.count("# TYPE midgpt_hits_total counter") == 1
+
+
+def test_prometheus_text_covers_engine_counter_contract(model):
+    """Every registry-backed engine counter (the objects behind the
+    pinned ENGINE_STATS_KEYS facade) must appear in the exposition —
+    the exporter cannot silently drop part of the contract surface."""
+    from midgpt_tpu.serving.engine import _ENGINE_COUNTERS
+    from midgpt_tpu.telemetry import prometheus_text
+
+    eng, _ = _run(model)
+    text = prometheus_text(eng.metrics_snapshot())
+    for name in _ENGINE_COUNTERS:
+        assert f"midgpt_{name}_total" in text, name
+    # always-on histograms ride along (queue delay observed per admit)
+    assert "midgpt_queue_delay_s_bucket" in text
+    assert "# TYPE midgpt_tokens_generated_total counter" in text
+
+
+def test_prometheus_text_cluster_expands_replicas(model):
+    """A cluster snapshot expands to per-replica series plus the
+    cluster-level scalars as scope="cluster" gauges."""
+    from midgpt_tpu.telemetry import prometheus_text
+
+    cl = ServingCluster(model, replicas=2, **_KW)
+    for i, p in enumerate(_prompts(4)):
+        cl.submit(p, 8, seed=i)
+    cl.run()
+    text = prometheus_text(cl.metrics_snapshot())
+    assert 'midgpt_tokens_generated_total{replica="0"}' in text
+    assert 'midgpt_tokens_generated_total{replica="1"}' in text
+    assert 'midgpt_failovers{scope="cluster"} 0' in text
+    assert 'midgpt_dp_replicas{scope="cluster"} 2' in text
