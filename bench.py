@@ -641,16 +641,22 @@ def main() -> None:
 
 
     # --- comms audit: static per-step wire traffic of the headline -------
-    # config (midgpt_tpu.analysis). Recompiling the measured step is an
+    # config (midgpt_tpu.analysis). Recompiling the measured program is an
     # executable-cache hit right after its rung ran; the scalar split
-    # (total / DCN bytes, collective count) rides the BENCH_*.json record
-    # so the trajectory tracks comms alongside MFU.
+    # (ICI / DCN bytes per axis, collective count) rides the BENCH_*.json
+    # record so the trajectory tracks comms alongside MFU. window_steps
+    # makes the audit compile the SAME fused K-step window the headline
+    # rung dispatched (scan mode fuses _SCAN_STEPS+1 steps), not a K=1
+    # program the trainer never launched.
     audit_cfg = xcfg if xcfg is not None else cfg
     if audit_cfg is not None and time.perf_counter() - t_start < 540:
         try:
             from midgpt_tpu.analysis.harness import train_step_comms_summary
 
-            record.update(train_step_comms_summary(audit_cfg))
+            record.update(train_step_comms_summary(
+                audit_cfg,
+                window_steps=record.get("steps_per_dispatch", 1),
+            ))
         except Exception as exc:  # noqa: BLE001 — audit rung is best-effort
             exc.__traceback__ = None
             record["comms_error"] = repr(exc)[:120]

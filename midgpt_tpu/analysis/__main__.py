@@ -237,6 +237,37 @@ def build_parser() -> argparse.ArgumentParser:
         "(auto, the default)",
     )
     p.add_argument(
+        "--train-audit", action="store_true",
+        help="run the TRAIN-side verification suite (the seventh audit "
+        "family) instead of the per-step HLO audit: trace the fused "
+        "K-step train window through the trainer's own "
+        "get_train_window cache and PROVE the mixed-precision "
+        "choreography (bf16 matmul operands, f32 master params + Adam "
+        "moments, f32 loss/softmax, compute-dtype grad-accum carry, "
+        "remat recompute structure — analysis.train_choreo); compile "
+        "the window and gate its ICI/DCN collective wire bytes against "
+        "the checked-in per-geometry cells (budgets.TRAIN_BUDGETS); "
+        "and gate the launch structure (one launch per window, "
+        "grad-accum scan of trip G, zero host transfers, 100%% donation "
+        "aliasing — budgets.TRAIN_DISPATCH_BUDGETS). Runs K=1 AND K=4 "
+        "by default (--train-window-steps); the CI train-audit job "
+        "fans the three --train-geometry values out as a matrix.",
+    )
+    p.add_argument(
+        "--train-geometry", default="fsdp", metavar="G",
+        choices=("fsdp", "tp_fsdp", "dcn2"),
+        help="with --train-audit: the mesh geometry cell to audit "
+        "(budgets.TRAIN_AUDIT_GEOMETRIES; all need --mesh 8): 'fsdp' = "
+        "8-way FSDP, 'tp_fsdp' = tensor=2 x fsdp=4, 'dcn2' = 2 slices "
+        "over DCN with fsdp=4 inside each (default fsdp)",
+    )
+    p.add_argument(
+        "--train-window-steps", default="1,4", metavar="K[,K...]",
+        help="with --train-audit: comma-separated fused-window lengths "
+        "to audit (default '1,4' — the budget cells pin the two equal, "
+        "which is itself the window-scan invariant)",
+    )
+    p.add_argument(
         "--mesh-shape", default=None, metavar="SPEC",
         help="serving-audit mesh, e.g. 'tp=2' or 'tp=2,replica=2' "
         "(keys: tp/tensor, dp/replica, fsdp): compile/audit the three "
@@ -654,6 +685,69 @@ def _run_serving(args, cfg, mesh_shape) -> int:
     return 0
 
 
+def _run_train_audit(args, cfg) -> int:
+    """The --train-audit mode: prover + traffic cells + dispatch gate
+    for one mesh geometry of the fused train window (see the flag help
+    for the contract). Budget gating only applies when the audited
+    config/window match what the cells were measured at
+    (budgets.TRAIN_AUDIT_GEOMETRY) — like the serving budget_geom
+    guard, a non-matching invocation still runs the prover but reports
+    the missing cells as violations."""
+    from midgpt_tpu.analysis.budgets import TRAIN_AUDIT_GEOMETRY
+    from midgpt_tpu.analysis.harness import audit_train
+
+    try:
+        window_steps = tuple(
+            int(s) for s in args.train_window_steps.split(",") if s.strip()
+        )
+    except ValueError:
+        print(
+            f"error: bad --train-window-steps {args.train_window_steps!r} "
+            "(want comma-separated ints)",
+            file=sys.stderr,
+        )
+        return 2
+    if not window_steps or any(k < 1 for k in window_steps):
+        print(
+            "error: --train-window-steps needs at least one K >= 1",
+            file=sys.stderr,
+        )
+        return 2
+    if args.config != TRAIN_AUDIT_GEOMETRY["config"]:
+        print(
+            f"# note: train budget cells were measured on "
+            f"{TRAIN_AUDIT_GEOMETRY['config']!r}; auditing "
+            f"{args.config!r} will report missing cells",
+            file=sys.stderr,
+        )
+    report = audit_train(cfg, args.train_geometry, window_steps)
+    out = {
+        "config": args.config,
+        "mode": "train-audit",
+        "geometry": args.train_geometry,
+        "window_steps": list(window_steps),
+        **{k: v for k, v in report.items() if k != "geometry"},
+    }
+    if args.print_budgets:
+        print(
+            "# analysis/budgets.py TRAIN_BUDGETS fragment (measured):",
+            file=sys.stderr,
+        )
+        for cell in report["cells"]:
+            traf = cell["traffic"]
+            entry = {
+                "ici_bytes": traf["ici_bytes"],
+                "dcn_bytes": traf["dcn_bytes"],
+                "by_axis": traf["by_axis"],
+            }
+            print(
+                f"    ({args.train_geometry!r}, "
+                f"{cell['window_steps']}): " + json.dumps(entry),
+                file=sys.stderr,
+            )
+    return _emit_report(out, report["ok"], report["violations"], args)
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -722,6 +816,8 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
+    if args.train_audit:
+        return _run_train_audit(args, cfg)
     if args.serving:
         return _run_serving(args, cfg, mesh_shape)
     if args.choreo and args.fusion:

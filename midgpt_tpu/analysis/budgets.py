@@ -334,3 +334,240 @@ def check_budget(
             "the program interface"
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# TRAIN-side budgets: per-(mesh geometry, window K) wire-byte cells for
+# the compiled fused train window, plus the window dispatch budget.
+# Same philosophy as the serving cells above — exact measured values
+# with a band — but the classified quantity is COLLECTIVE WIRE BYTES
+# split by interconnect tier (cost.py's ring arithmetic): ICI bytes stay
+# inside a slice; DCN bytes cross slices. The bug classes each cell
+# catches:
+#
+# - a param spec widened across the slice axis (cross-slice FSDP
+#   re-gather) moves the whole per-step gather/reduce-scatter volume
+#   from ICI onto DCN — the dcn2 cell's ``dcn_bytes`` band trips AND the
+#   single-slice cells' expected-zero DCN trips on any bytes at all;
+# - an f32 operand reaching a collective that should carry bf16 doubles
+#   that axis's bytes past the 4% band (this is how the psum-dtype
+#   clause of the precision contract is gated — the jaxpr-level prover
+#   cannot see collectives, see train_choreo's scope note);
+# - a resharded activation or an extra all-gather shows up as an
+#   unexpected ``by_axis`` key (its own violation, like ``unclassified``
+#   in the serving cells).
+#
+# K=1 and K=4 cells are IDENTICAL by construction — cost.py counts a
+# scan-body collective once per dispatch, and the fused window executes
+# the same per-step collective set K times inside one scan. Checking
+# both K values pins exactly that: a window whose bytes GREW with K has
+# lost the scan (re-unrolled window) even before the dispatch gate runs.
+# ---------------------------------------------------------------------------
+
+# the geometry every train cell below was measured at (shrunk
+# openwebtext; batch 16 so the microbatch divides every batch-sharding
+# in TRAIN_AUDIT_GEOMETRIES)
+TRAIN_AUDIT_GEOMETRY: tp.Dict[str, tp.Any] = {
+    "config": "openwebtext",
+    "n_layer": 2,
+    "block_size": 256,
+    "vocab_size": 1024,
+    "batch_size": 16,
+    "g_accum_iters": 2,
+}
+
+# the three mesh geometries the CI train-audit matrix compiles (8 host
+# devices via --xla_force_host_platform_device_count): pure FSDP, a
+# tensor*fsdp hybrid, and a 2-slice DCN mesh with FSDP inside each slice
+TRAIN_AUDIT_GEOMETRIES: tp.Dict[str, tp.Dict[str, int]] = {
+    "fsdp": dict(replica=1, fsdp=8, sequence=1, tensor=1),
+    "tp_fsdp": dict(replica=1, fsdp=4, sequence=1, tensor=2),
+    "dcn2": dict(replica=2, fsdp=4, sequence=1, tensor=1, num_slices=2),
+}
+
+# measured cells, keyed (geometry, window_steps). ``by_axis`` is the
+# full per-mesh-axis split ("+"-joined for multi-axis collectives); any
+# axis key not present here is an unexpected collective. Regenerate
+# after an intentional change with::
+#
+#     python -m midgpt_tpu.analysis --config openwebtext --train-audit \
+#         --train-geometry <g> --print-budgets
+TRAIN_BUDGETS: tp.Dict[
+    tp.Tuple[str, int], tp.Dict[str, tp.Any]
+] = {
+    ("fsdp", 1): {
+        "ici_bytes": 108739547, "dcn_bytes": 0,
+        "by_axis": {"fsdp": 108739547},
+    },
+    ("fsdp", 4): {
+        "ici_bytes": 108739547, "dcn_bytes": 0,
+        "by_axis": {"fsdp": 108739547},
+    },
+    ("tp_fsdp", 1): {
+        "ici_bytes": 71978366, "dcn_bytes": 0,
+        "by_axis": {"fsdp": 50725710, "tensor": 21252656},
+    },
+    ("tp_fsdp", 4): {
+        "ici_bytes": 71978366, "dcn_bytes": 0,
+        "by_axis": {"fsdp": 50725710, "tensor": 21252656},
+    },
+    # dcn2: the per-slice FSDP gathers stay on ICI; the cross-slice
+    # grad reduction (replica axis + the replica+fsdp mixed reduce)
+    # is the ONLY traffic allowed on DCN
+    ("dcn2", 1): {
+        "ici_bytes": 92605512, "dcn_bytes": 14156679,
+        "by_axis": {
+            "fsdp": 92605512, "replica+fsdp": 5505927,
+            "replica": 8650752,
+        },
+    },
+    ("dcn2", 4): {
+        "ici_bytes": 92605512, "dcn_bytes": 14156679,
+        "by_axis": {
+            "fsdp": 92605512, "replica+fsdp": 5505927,
+            "replica": 8650752,
+        },
+    },
+}
+
+# launch-side window budget (same on every geometry — the dispatch
+# structure is mesh-independent): ONE launch per K-step window, the
+# grad-accum loop folded as a scan of trip G, zero host transfers, and
+# 100% of the donated train state aliased in the compiled executable
+# (27 leaves at the audit geometry: 8 params + step + 8 mu + 8 nu +
+# 2 optax counts)
+TRAIN_DISPATCH_BUDGETS: tp.Dict[str, int] = {
+    "launches_per_window": 1,
+    "accum_scan_length": TRAIN_AUDIT_GEOMETRY["g_accum_iters"],
+    "host_transfers": 0,
+    "donated_leaves": 27,
+}
+
+
+def train_budget_for(
+    geometry: str, window_steps: int
+) -> tp.Optional[tp.Dict[str, tp.Any]]:
+    return TRAIN_BUDGETS.get((geometry, window_steps))
+
+
+def check_train_budget(
+    report: tp.Mapping[str, tp.Any],  # harness.train_traffic_cell dict
+    budget: tp.Mapping[str, tp.Any],
+    *,
+    geometry: str = "",
+    tolerance: float = TOLERANCE,
+) -> tp.List[str]:
+    """Evaluate one compiled window's measured wire bytes against its
+    cell; returns violation strings (empty = pass). Bands work both
+    ways (a collective that vanished means the compiler stopped
+    sharding something, not that training got free) — except
+    expected-zero tiers, which trip on ANY bytes: a single DCN byte on
+    a single-slice mesh means a spec leaked across the slice axis."""
+    out: tp.List[str] = []
+    tag = f"train_window[{geometry}]" if geometry else "train_window"
+    for tier in ("ici_bytes", "dcn_bytes"):
+        expect = budget.get(tier)
+        if expect is None:
+            continue
+        got = int(report.get(tier, 0))
+        if expect == 0:
+            if got:
+                out.append(
+                    f"{tag}: {got:,} B of {tier.split('_')[0].upper()} "
+                    f"traffic where the budget expects NONE — a sharding "
+                    "spec crossed the slice boundary (the cross-slice "
+                    "re-gather bug class)"
+                )
+            continue
+        lo = int(expect * (1 - tolerance))
+        hi = int(expect * (1 + tolerance))
+        if not (lo <= got <= hi):
+            hint = ""
+            if got > hi:
+                hint = (
+                    " — extra collective volume joined the step (an f32 "
+                    "operand on a bf16 collective, or a re-gathered "
+                    "buffer)"
+                )
+            out.append(
+                f"{tag}: {tier} {got:,} B outside budget "
+                f"[{lo:,}, {hi:,}] (expected ~{expect:,}){hint}"
+            )
+    expect_axes = budget.get("by_axis")
+    if expect_axes is not None:
+        got_axes = dict(report.get("by_axis", {}))
+        for axis, b in got_axes.items():
+            if axis not in expect_axes and b:
+                out.append(
+                    f"{tag}: unexpected collective axis '{axis}' "
+                    f"carrying {b:,} B — a collective the budget has "
+                    "never seen joined the window"
+                )
+        for axis, expect in expect_axes.items():
+            got = int(got_axes.get(axis, 0))
+            lo = int(expect * (1 - tolerance))
+            hi = int(expect * (1 + tolerance))
+            if not (lo <= got <= hi):
+                out.append(
+                    f"{tag}: axis '{axis}' {got:,} B outside budget "
+                    f"[{lo:,}, {hi:,}] (expected ~{expect:,})"
+                )
+    return out
+
+
+def check_train_dispatch_budget(
+    report,  # dispatch.TrainDispatchReport
+    budget: tp.Mapping[str, int] = TRAIN_DISPATCH_BUDGETS,
+    *,
+    aliased_leaves: tp.Optional[int] = None,
+) -> tp.List[str]:
+    """Evaluate the traced window's launch structure (plus, when
+    ``aliased_leaves`` is given, the compiled donation accounting)
+    against the train dispatch budget. Exact equality, like the
+    serving dispatch cells — launch structure is integral."""
+    out: tp.List[str] = []
+    got = report.to_dict()
+    for key in ("launches_per_window", "accum_scan_length",
+                "host_transfers"):
+        expect = budget.get(key)
+        if expect is None:
+            continue
+        if got[key] != expect:
+            hint = ""
+            if key == "launches_per_window":
+                hint = (
+                    " — the K-step window scan is gone; every step pays "
+                    "dispatch latency again"
+                )
+            elif key == "accum_scan_length" and got[key] == 0:
+                hint = (
+                    " — the grad-accum loop re-unrolled (G inlined "
+                    "copies of the step body, zero bytes moved)"
+                )
+            elif key == "host_transfers":
+                hint = " — a host callback joined the fused window"
+            out.append(
+                f"{report.program}: {key} {got[key]} != budget "
+                f"{expect}{hint}"
+            )
+    expect_donated = budget.get("donated_leaves")
+    if aliased_leaves is not None and expect_donated is not None:
+        if aliased_leaves != expect_donated:
+            out.append(
+                f"{report.program}: {aliased_leaves} donated state "
+                f"leaves aliased in the executable != budget "
+                f"{expect_donated} — un-aliased donation doubles the "
+                "train state's HBM residency"
+            )
+    return out
+
+
+def train_geometry_key(mesh_shape: tp.Mapping[str, int]) -> tp.Optional[str]:
+    """Reverse lookup: the TRAIN_AUDIT_GEOMETRIES name whose axis sizes
+    match ``mesh_shape`` (num_slices included), or None."""
+    probe = {k: v for k, v in mesh_shape.items() if v != 1}
+    for name, axes in TRAIN_AUDIT_GEOMETRIES.items():
+        ref = {k: v for k, v in axes.items() if v != 1}
+        if probe == ref:
+            return name
+    return None

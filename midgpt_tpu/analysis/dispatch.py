@@ -169,6 +169,102 @@ def _walk(jpr, depth: int, scans: tp.List[ScanInfo],
     return found_attn_scan
 
 
+@dataclasses.dataclass(frozen=True)
+class TrainDispatchReport:
+    """Static launch structure of the traced K-step TRAIN window.
+
+    The training-side dispatch contract (train.make_train_window):
+
+    - the whole window is ONE XLA dispatch — a depth-0 scan of trip
+      count K carrying the optimizer state (``window_scan_length``);
+      K separate launches would re-pay the relay/dispatch latency the
+      fused window exists to amortize (PERF.md r5);
+    - the grad-accum loop inside each step is a ``lax.scan`` of trip
+      count G (``accum_scan_length``) — re-unrolling it (the PR 11
+      serving bug class, training-side) moves zero wire bytes but
+      multiplies the compiled body by G;
+    - no host transfers anywhere in the window (a mid-window callback
+      serializes the whole fused dispatch).
+
+    Donation accounting (100% of the donated state aliased) needs the
+    compiled HLO, so it rides the traffic cell
+    (:func:`midgpt_tpu.analysis.harness.train_traffic_cell`), not this
+    trace-level report."""
+
+    program: str
+    window_steps: int  # expected K
+    g_accum_iters: int  # expected G
+    window_scan_length: int  # traced window-scan trip count (0 = absent)
+    accum_scan_length: int  # traced accum-scan trip count (0 = absent)
+    accum_carry_leaves: int  # float leaves carried by the accum scan
+    host_transfers: int
+
+    @property
+    def launches_per_window(self) -> int:
+        """1 when the K-step window scan is intact; K when the window
+        structure is gone (each step body would need its own launch to
+        preserve the step boundary the trainer observes)."""
+        return (
+            1
+            if self.window_scan_length == self.window_steps
+            else self.window_steps
+        )
+
+    def to_dict(self) -> tp.Dict[str, tp.Any]:
+        return {
+            "program": self.program,
+            "window_steps": self.window_steps,
+            "g_accum_iters": self.g_accum_iters,
+            "window_scan_length": self.window_scan_length,
+            "accum_scan_length": self.accum_scan_length,
+            "accum_carry_leaves": self.accum_carry_leaves,
+            "launches_per_window": self.launches_per_window,
+            "host_transfers": self.host_transfers,
+        }
+
+
+def train_dispatch_report(
+    closed_jaxpr, *, window_steps: int, g_accum_iters: int,
+    program: str = "train_window",
+) -> TrainDispatchReport:
+    """Build the :class:`TrainDispatchReport` from a traced window
+    jaxpr (``jax.make_jaxpr`` over ``train.get_train_window``'s
+    program — no compilation). Scan identification is structural:
+    the window scan is the depth-0 scan carrying an int32 scalar
+    (``state.step`` + optax counts); the accum scan nests directly
+    inside it and carries the whole grad tree plus the f32 loss
+    accumulator (>= 3 float leaves — the layer scans carry one)."""
+    from midgpt_tpu.analysis.train_choreo import (
+        find_accum_scan,
+        find_window_scan,
+        window_scans,
+    )
+
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    host = [0]
+    _count_host_transfers(jaxpr, host)
+    scans = window_scans(closed_jaxpr)
+    wscan = find_window_scan(scans, window_steps)
+    ascan = find_accum_scan(scans, wscan is not None)
+    return TrainDispatchReport(
+        program=program,
+        window_steps=window_steps,
+        g_accum_iters=g_accum_iters,
+        window_scan_length=wscan.length if wscan is not None else 0,
+        accum_scan_length=ascan.length if ascan is not None else 0,
+        accum_carry_leaves=ascan.float_carries if ascan is not None else 0,
+        host_transfers=host[0],
+    )
+
+
+def _count_host_transfers(jpr, host: tp.List[int]) -> None:
+    for eqn in jpr.eqns:
+        if eqn.primitive.name in _HOST_TRANSFER_PRIMS:
+            host[0] += 1
+        for p in _param_jaxprs(eqn.params):
+            _count_host_transfers(getattr(p, "jaxpr", p), host)
+
+
 def dispatch_report(
     closed_jaxpr, *, program: str, window_steps: int = 1
 ) -> DispatchReport:

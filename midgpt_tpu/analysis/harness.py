@@ -1025,19 +1025,330 @@ def audit_serving_dispatch(
     return reports, violations
 
 
-def train_step_comms_summary(cfg: ExperimentConfig) -> tp.Dict[str, tp.Any]:
+def train_step_comms_summary(
+    cfg: ExperimentConfig, *, window_steps: tp.Optional[int] = None
+) -> tp.Dict[str, tp.Any]:
     """Flat scalar comms summary for an already-benchmarked config —
     bench.py attaches this to its one-JSON-line record. Compiles the
-    step as-is (the executable cache makes this a cache hit right after
-    a bench rung ran the same config)."""
-    analysis = analyze_train_step(cfg, shrink=False)
+    program the bench actually dispatched: the fused K-step window when
+    ``window_steps > 1`` (bench's scan dispatch mode), the single step
+    otherwise (the executable cache makes either a cache hit right
+    after the bench rung compiled the same program). Per-axis byte
+    splits are flattened into ``comms_axis_<axis>_bytes_per_step``
+    scalars ('+' -> '_') so the one-line JSON record stays flat."""
     from midgpt_tpu.analysis.cost import cost_report
 
+    k = window_steps if window_steps is not None else 1
+    if k > 1:
+        hlo, mesh, donated, _ = compile_train_window(cfg, k)
+        analysis = StepAnalysis.from_text(
+            hlo,
+            hlo_mod.MeshInfo.from_mesh(
+                mesh, num_slices=cfg.mesh.num_slices
+            ),
+            global_batch=cfg.batch_size,
+            block=cfg.model.block_size,
+            donated_leaves=donated,
+        )
+    else:
+        analysis = analyze_train_step(cfg, shrink=False)
     rep = cost_report(analysis)
-    return {
+    out: tp.Dict[str, tp.Any] = {
         "comms_traffic_bytes_per_step": rep["value"],
         "comms_dcn_bytes_per_step": rep["dcn_bytes"],
+        "comms_ici_bytes_per_step": rep["ici_bytes"],
         "comms_collective_count": rep["collective_count"],
+        "comms_window_steps": k,
+    }
+    for axis, b in sorted(dict(rep["by_axis"]).items()):
+        out[f"comms_axis_{axis.replace('+', '_')}_bytes_per_step"] = b
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TRAIN-side verification suite (analysis --train-audit): precision
+# choreography prover + traffic cells + window dispatch gate for the
+# fused K-step train window, at the checked-in audit geometry matrix.
+# ---------------------------------------------------------------------------
+
+
+def shrink_for_train_audit(
+    cfg: ExperimentConfig,
+    geometry: str,
+    *,
+    remat: str = "none",
+) -> ExperimentConfig:
+    """Audit-sized variant of ``cfg`` pinned to the train budget cell
+    geometry (:data:`~midgpt_tpu.analysis.budgets.TRAIN_AUDIT_GEOMETRY`
+    × :data:`~midgpt_tpu.analysis.budgets.TRAIN_AUDIT_GEOMETRIES`):
+    the real trainer's code paths (grad accumulation G=2, fused window,
+    layer scan) shrunk so every mesh geometry in the matrix compiles in
+    seconds on the 8-device CPU virtual mesh. ``batch_size`` 16 keeps
+    the microbatch divisible by every batch sharding in the matrix
+    (8-way fsdp, 2×4 replica×fsdp, 4-way fsdp under tensor=2)."""
+    from midgpt_tpu.analysis.budgets import (
+        TRAIN_AUDIT_GEOMETRIES,
+        TRAIN_AUDIT_GEOMETRY,
+    )
+    from midgpt_tpu.config import MeshConfig
+
+    g = TRAIN_AUDIT_GEOMETRY
+    model = dataclasses.replace(
+        cfg.model,
+        n_layer=g["n_layer"],
+        block_size=g["block_size"],
+        vocab_size=g["vocab_size"],
+        remat=remat,
+        scan_unroll=1,
+    )
+    return dataclasses.replace(
+        cfg,
+        model=model,
+        batch_size=g["batch_size"],
+        g_accum_iters=g["g_accum_iters"],
+        loss_chunk=None,
+        mesh=MeshConfig(**TRAIN_AUDIT_GEOMETRIES[geometry]),
+    )
+
+
+def compile_train_window(
+    cfg: ExperimentConfig,
+    window_steps: int,
+    *,
+    tx=None,
+    param_rules=None,
+    logical_overrides: tp.Optional[tp.Mapping[str, tp.Any]] = None,
+):
+    """Compile the fused K-step window UNCONDITIONALLY — unlike
+    :func:`compile_train_step`, which picks the per-step jit at
+    ``steps_per_dispatch == 1``. The train budget cells gate the window
+    program at both K=1 and K=4, and the byte identity between them is
+    itself a checked invariant (a window whose bytes grow with K has
+    lost the scan). ``tx`` / ``param_rules`` / ``logical_overrides``
+    are fault-injection seams (a mis-dtyped optimizer chain, a widened
+    sharding spec); production callers leave them None.
+
+    Returns ``(hlo_text, mesh, donated_leaves, aliased_leaves)`` —
+    the last is the count of distinct entry parameters the compiled
+    executable input/output-aliases (the donation accounting)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.parallel.sharding import make_global_array
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_window
+
+    mesh = create_mesh(cfg.mesh)
+    if tx is None:
+        tx, _ = make_optimizer(cfg)
+    rules_kw = {} if param_rules is None else {"param_rules": param_rules}
+    with override_logical_rules(logical_overrides):
+        state = init_state(
+            cfg, mesh, tx, jax.random.PRNGKey(0), abstract=True, **rules_kw
+        )
+        step = make_train_window(cfg, tx, mesh, window_steps, **rules_kw)
+        x = np.zeros(
+            (window_steps, cfg.g_accum_iters, cfg.microbatch_size,
+             cfg.model.block_size),
+            np.int32,
+        )
+        xg = make_global_array(x, mesh, P(None, *BATCH_SPEC_AXES))
+        hlo = step.lower(
+            state, xg, xg, jax.random.PRNGKey(1)
+        ).compile().as_text()
+    donated = len(jax.tree.leaves(state))
+    aliased = len({
+        e.param_number for e in hlo_mod.parse_input_output_alias(hlo)
+    })
+    return hlo, mesh, donated, aliased
+
+
+def trace_train_window(
+    cfg: ExperimentConfig,
+    window_steps: int,
+    *,
+    mesh=None,
+    tx=None,
+    use_cache: bool = True,
+):
+    """Trace (``jax.make_jaxpr``) + ``jax.eval_shape`` the fused window
+    program. ``use_cache=True`` resolves it through
+    ``train.get_train_window`` — the very cache the trainer launches
+    from, so the proof covers the shipped lookup path, not a
+    reconstruction. Fault-injection callers pass ``use_cache=False``
+    (plus ``tx``) to build a poisoned window via ``make_train_window``
+    without polluting the shared cache. Returns
+    ``(closed_jaxpr, (new_state, aux) shape tree)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.train import (
+        get_train_window,
+        init_state,
+        make_optimizer,
+        make_train_window,
+    )
+
+    if mesh is None:
+        mesh = create_mesh(cfg.mesh)
+    if tx is None:
+        tx, _ = make_optimizer(cfg)
+    state = init_state(cfg, mesh, tx, jax.random.PRNGKey(0), abstract=True)
+    xs = jax.ShapeDtypeStruct(
+        (window_steps, cfg.g_accum_iters, cfg.microbatch_size,
+         cfg.model.block_size),
+        jnp.int32,
+    )
+    if use_cache:
+        prog = get_train_window(cfg, mesh, window_steps)
+    else:
+        prog = make_train_window(cfg, tx, mesh, window_steps)
+    key = jax.random.PRNGKey(1)
+    closed = jax.make_jaxpr(prog)(state, xs, xs, key)
+    out_tree = jax.eval_shape(prog, state, xs, xs, key)
+    return closed, out_tree
+
+
+def prove_train_window_choreography(
+    cfg: ExperimentConfig,
+    geometry: str,
+    window_steps: int,
+):
+    """Run the mixed-precision choreography prover on the REAL cached
+    window program at the audit geometry: traces the ``remat="none"``
+    leg through ``train.get_train_window`` plus a ``remat="full"`` leg
+    for the recompute-structure check. Returns the
+    :class:`~midgpt_tpu.analysis.train_choreo.TrainChoreoReport`."""
+    from midgpt_tpu.analysis.train_choreo import prove_window_choreography
+
+    base = shrink_for_train_audit(cfg, geometry, remat="none")
+    closed, out_tree = trace_train_window(base, window_steps)
+    remat_cfg = shrink_for_train_audit(cfg, geometry, remat="full")
+    remat_closed, _ = trace_train_window(remat_cfg, window_steps)
+    return prove_window_choreography(
+        closed,
+        out_tree,
+        window_steps=window_steps,
+        g_accum_iters=base.g_accum_iters,
+        remat_closed=remat_closed,
+    )
+
+
+def train_traffic_cell(
+    cfg: ExperimentConfig, geometry: str, window_steps: int
+) -> tp.Dict[str, tp.Any]:
+    """Compile the window at the audit geometry and measure its budget
+    cell: ICI/DCN collective wire bytes + the per-mesh-axis split
+    (cost.py's ring arithmetic on the compiled HLO), plus the donation
+    accounting off the same executable. Keys line up with
+    :data:`~midgpt_tpu.analysis.budgets.TRAIN_BUDGETS`."""
+    from midgpt_tpu.analysis.cost import cost_report
+
+    audit = shrink_for_train_audit(cfg, geometry)
+    hlo, mesh, donated, aliased = compile_train_window(audit, window_steps)
+    analysis = StepAnalysis.from_text(
+        hlo,
+        hlo_mod.MeshInfo.from_mesh(mesh, num_slices=audit.mesh.num_slices),
+        global_batch=audit.batch_size,
+        block=audit.model.block_size,
+        donated_leaves=donated,
+    )
+    rep = cost_report(analysis)
+    return {
+        "geometry": geometry,
+        "window_steps": window_steps,
+        "ici_bytes": rep["ici_bytes"],
+        "dcn_bytes": rep["dcn_bytes"],
+        "collective_count": rep["collective_count"],
+        "by_axis": dict(rep["by_axis"]),
+        "donated_leaves": donated,
+        "aliased_leaves": aliased,
+    }
+
+
+def train_dispatch_cell(
+    cfg: ExperimentConfig, geometry: str, window_steps: int
+):
+    """Trace-level window dispatch report at the audit geometry (the
+    launch-structure half of the train gate; the donation half rides
+    the compiled :func:`train_traffic_cell`)."""
+    from midgpt_tpu.analysis.dispatch import train_dispatch_report
+
+    audit = shrink_for_train_audit(cfg, geometry)
+    closed, _ = trace_train_window(audit, window_steps)
+    return train_dispatch_report(
+        closed,
+        window_steps=window_steps,
+        g_accum_iters=audit.g_accum_iters,
+    )
+
+
+def audit_train(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    geometry: str,
+    window_steps: tp.Sequence[int] = (1, 4),
+) -> tp.Dict[str, tp.Any]:
+    """One-call train audit for one mesh geometry: for each K, prove
+    the precision choreography on the cached window trace, gate the
+    compiled wire bytes against
+    :data:`~midgpt_tpu.analysis.budgets.TRAIN_BUDGETS`, and gate the
+    launch structure + donation against
+    :data:`~midgpt_tpu.analysis.budgets.TRAIN_DISPATCH_BUDGETS`.
+    Returns a JSON-able report with a flat ``violations`` list
+    (empty = green)."""
+    from midgpt_tpu.analysis.budgets import (
+        check_train_budget,
+        check_train_dispatch_budget,
+        train_budget_for,
+    )
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    cells: tp.List[tp.Dict[str, tp.Any]] = []
+    violations: tp.List[str] = []
+    for k in window_steps:
+        prover = prove_train_window_choreography(cfg, geometry, k)
+        for c in prover.checks:
+            if not c.ok:
+                violations.append(
+                    f"train_window[{geometry}] k={k}: prover check "
+                    f"'{c.name}' failed — {c.detail}"
+                )
+        traffic = train_traffic_cell(cfg, geometry, k)
+        budget = train_budget_for(geometry, k)
+        if budget is None:
+            violations.append(
+                f"train_window[{geometry}] k={k}: no checked-in budget "
+                "cell — regenerate with --print-budgets"
+            )
+        else:
+            violations.extend(
+                f"k={k}: {v}"
+                for v in check_train_budget(traffic, budget,
+                                            geometry=geometry)
+            )
+        disp = train_dispatch_cell(cfg, geometry, k)
+        violations.extend(
+            f"k={k}: {v}"
+            for v in check_train_dispatch_budget(
+                disp, aliased_leaves=traffic["aliased_leaves"]
+            )
+        )
+        cells.append({
+            "window_steps": k,
+            "choreography": prover.to_dict(),
+            "traffic": traffic,
+            "dispatch": disp.to_dict(),
+        })
+    return {
+        "geometry": geometry,
+        "ok": not violations,
+        "violations": violations,
+        "cells": cells,
     }
 
 

@@ -62,6 +62,7 @@ __all__ = [
     "load_suite_timing",
     "load_trajectory",
     "markdown_report",
+    "parse_multichip_record",
     "row_hardware",
     "row_kind",
     "row_ok",
@@ -85,6 +86,8 @@ class Row:
 
 
 def row_kind(rec: tp.Mapping[str, tp.Any]) -> str:
+    if rec.get("kind") == "multichip":
+        return "multichip"
     if "serve_shape" in rec:
         return "serving"
     if rec.get("kind") == "suite" or "suite_total_call_s" in rec:
@@ -119,15 +122,67 @@ def load_record(path: str) -> tp.Dict[str, tp.Any]:
 
 
 _BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+
+#: tail-line prefixes of the multichip dryrun driver, mapped to the
+#: ledger key tag each loss lands under. Order matters: more specific
+#: prefixes first ("dryrun GPT pipeline" before "dryrun pipeline").
+_MULTICHIP_LINE_TAGS: tp.Tuple[tp.Tuple[str, str], ...] = (
+    ("dryrun_multichip", "mesh"),
+    ("dryrun fused attention", "fused_attention"),
+    ("dryrun MoE expert parallelism", "moe"),
+    ("dryrun ring attention", "ring_attention"),
+    ("dryrun ulysses", "ulysses"),
+    ("dryrun multi-slice", "multi_slice"),
+    ("dryrun GPT pipeline", "gpt_pipeline"),
+    ("dryrun pipeline", "pipeline"),
+)
+
+_MULTICHIP_LOSS_RE = re.compile(r"loss=([0-9][0-9.eE+-]*)")
+
+
+def parse_multichip_record(
+    raw: tp.Mapping[str, tp.Any],
+) -> tp.Dict[str, tp.Any]:
+    """A ``MULTICHIP_r*.json`` driver wrapper as a ledger row: the
+    per-parallelism dryrun losses from the ``tail`` text become
+    ``multichip_<tag>_loss`` keys (STATIC-banded — a loss that drifts
+    between rounds on a fixed seed/geometry means a parallelism path
+    changed numerics), ``n_devices`` is the population key, and a
+    non-ok/skipped wrapper becomes a wedge row (``status='error'``,
+    excluded from the reference like the r4/r5 BENCH wedges)."""
+    ok = (
+        bool(raw.get("ok"))
+        and raw.get("rc", 1) == 0
+        and not raw.get("skipped")
+    )
+    rec: tp.Dict[str, tp.Any] = {
+        "kind": "multichip",
+        "status": "ok" if ok else "error",
+        "n_devices": raw.get("n_devices"),
+    }
+    for line in str(raw.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.endswith("OK"):
+            continue
+        m = _MULTICHIP_LOSS_RE.search(line)
+        if not m:
+            continue
+        for prefix, tag in _MULTICHIP_LINE_TAGS:
+            if line.startswith(prefix):
+                rec[f"multichip_{tag}_loss"] = float(m.group(1))
+                break
+    return rec
 
 
 def load_trajectory(
     root: str, record_dirs: tp.Sequence[str] = (),
 ) -> tp.List[Row]:
     """The reference trajectory: every ``BENCH_r*.json`` under ``root``
-    (ordered by round number), then every ``*.json`` bench record in
-    ``record_dirs`` (file order) — the r6 queue's per-rung records and
-    CI-archived rows ingest this way."""
+    (ordered by round number), then every ``MULTICHIP_r*.json`` (round
+    order, indices continuing past the BENCH rounds), then every
+    ``*.json`` bench record in ``record_dirs`` (file order) — the r6
+    queue's per-rung records and CI-archived rows ingest this way."""
     rows: tp.List[Row] = []
     for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
         m = _BENCH_RE.search(path)
@@ -139,6 +194,18 @@ def load_trajectory(
             continue
     rows.sort(key=lambda r: r.index)
     nxt = (rows[-1].index + 1) if rows else 0
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        if not _MULTICHIP_RE.search(path):
+            continue
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if not isinstance(raw, dict):
+            continue
+        rows.append(Row(path, nxt, parse_multichip_record(raw)))
+        nxt += 1
     for d in record_dirs:
         for path in sorted(glob.glob(os.path.join(d, "*.json"))):
             try:
@@ -225,6 +292,18 @@ BANDS: tp.Dict[str, Band] = {
     "serve_queue_delay_p99_ms": Band(LOWER, 0.25),
     # --- suite time (always informational: CI boxes vary) --------------
     "suite_total_call_s": Band(LOWER, 0.25),
+    # --- static: multichip dryrun losses (fixed seed + geometry — a
+    # drifting loss means a parallelism path changed numerics; the 5%
+    # band absorbs cross-version RNG/layout noise, which measured at
+    # most 0.64% across the shipped rounds) -----------------------------
+    "multichip_mesh_loss": Band(STATIC, 0.05),
+    "multichip_fused_attention_loss": Band(STATIC, 0.05),
+    "multichip_moe_loss": Band(STATIC, 0.05),
+    "multichip_ring_attention_loss": Band(STATIC, 0.05),
+    "multichip_ulysses_loss": Band(STATIC, 0.05),
+    "multichip_multi_slice_loss": Band(STATIC, 0.05),
+    "multichip_gpt_pipeline_loss": Band(STATIC, 0.05),
+    "multichip_pipeline_loss": Band(STATIC, 0.05),
 }
 
 #: Train headline keys that only compare between rows with the same
@@ -243,6 +322,12 @@ _FAMILY_TAGS = (
     ("long_ctx_", "long_ctx_metric"),
     ("decode_", "decode_shape"),
 )
+
+
+#: Kinds whose key inventory is gated HARD, restricted to their own
+#: prefix (losing a ``serve_``/``multichip_`` key is a schema break;
+#: other keys on those rows are wrapper metadata).
+_INVENTORY_PREFIXES = {"serving": "serve_", "multichip": "multichip_"}
 
 
 def _same_population(
@@ -268,6 +353,10 @@ def _same_population(
             cur.get("device") == ref.get("device")
             and cur.get("n_devices") == ref.get("n_devices")
         )
+    if kind == "multichip":
+        # the dryrun losses depend on the virtual device pool (mesh
+        # factorizations change with it) but not on the host device
+        return cur.get("n_devices") == ref.get("n_devices")
     return True
 
 
@@ -393,13 +482,17 @@ def diff_record(
             prev = row
             break
     if prev is not None:
+        # prefixed-inventory kinds gate hard on their own key family
+        # (the record-schema contract); train/suite rows only warn — a
+        # failed auxiliary rung legitimately drops its family
+        prefix = _INVENTORY_PREFIXES.get(kind)
         lost = [
             k for k in prev.record
-            if k not in cur and (kind != "serving" or k.startswith("serve_"))
+            if k not in cur and (prefix is None or k.startswith(prefix))
         ]
         for k in sorted(lost):
             findings.append(Finding(
-                "hard" if kind == "serving" else "info", k,
+                "hard" if prefix is not None else "info", k,
                 f"key present in {prev.source} is missing from the "
                 "current record (inventory shrank)",
             ))
@@ -421,6 +514,11 @@ _TREND_COLUMNS = {
         "serve_bytes_per_token_static", "status",
     ),
     "suite": ("suite_total_call_s", "suite_n_calls", "status"),
+    "multichip": (
+        "n_devices", "multichip_mesh_loss", "multichip_multi_slice_loss",
+        "multichip_gpt_pipeline_loss", "multichip_ring_attention_loss",
+        "multichip_moe_loss", "status",
+    ),
 }
 
 
@@ -450,7 +548,7 @@ def markdown_report(
         by_kind.setdefault(row_kind(rec), []).append(
             (f"**{os.path.basename(name)}** (current)", rec)
         )
-    for kind in ("train", "serving", "suite"):
+    for kind in ("train", "serving", "suite", "multichip"):
         entries = by_kind.get(kind)
         if not entries:
             continue
@@ -517,9 +615,18 @@ def run_ledger(
                 diff_record(rec, rows, hardware=hardware)
             )
     else:
-        # self-check mode: the newest OK row vs everything before it
-        ok_rows = [r for r in rows if row_ok(r.record)]
-        if ok_rows:
+        # self-check mode: the newest OK row OF EACH KIND vs everything
+        # before it — the trajectory now ships several families (train
+        # BENCH rounds, MULTICHIP rounds, ingested serving/suite rows),
+        # and a single global "latest" would leave every other family's
+        # shipped rows unchecked
+        for kind in ("train", "serving", "suite", "multichip"):
+            ok_rows = [
+                r for r in rows
+                if row_ok(r.record) and row_kind(r.record) == kind
+            ]
+            if not ok_rows:
+                continue
             last = ok_rows[-1]
             before = [r for r in rows if r.index < last.index]
             current.append((f"{last.source} (self-check)", last.record))

@@ -488,15 +488,25 @@ def lint_source(source: str, path: str = "<string>") -> tp.List[Finding]:
     lint.check_host_sync()
     lint.check_mesh_axes()
     lint.check_missing_donate()
-    # the model-closure rule is scoped to the serving package: that is
-    # where every jitted program's model MUST be an entry parameter
-    # (engine.py's program cache and the int8 path both depend on it);
-    # trainers legitimately close over config-derived structures
-    if "serving" in Path(path).parts:
+    # the model-closure rule covers the serving package — where every
+    # jitted program's model MUST be an entry parameter (engine.py's
+    # program cache and the int8 path both depend on it) — plus the
+    # train-side jit sites (train.py, bench.py): a train program that
+    # closes over params would silently constant-fold the whole model
+    # into the executable and break donation, exactly the PR 6 serving
+    # bug class on the other side of the fence. Trainers legitimately
+    # close over config-derived structures; only _MODEL_NAMES trip it.
+    if (
+        "serving" in Path(path).parts
+        or Path(path).name in ("train.py", "bench.py")
+    ):
         lint.check_model_closure()
-        # same scope for the layer-loop rule: serving program bodies
-        # must take the scan fold; the models/ drivers keep their
-        # unrolled branch as the fold's bitwise reference
+    if "serving" in Path(path).parts:
+        # the layer-loop rule stays serving-scoped: serving program
+        # bodies must take the scan fold; the models/ drivers keep
+        # their unrolled branch as the fold's bitwise reference, and
+        # train.py's loop structure is gated semantically by the
+        # train dispatch budget instead
         lint.check_unrolled_layer_loop()
     waivers = _pragma_waivers(source)
     findings = []

@@ -409,3 +409,30 @@ def floor_table_markdown(rows: tp.Sequence[tp.Dict[str, tp.Any]]) -> str:
             f"| {r['floor_ms_per_step']:.3f} |"
         )
     return "\n".join(lines)
+
+
+def train_budget_table_markdown(
+    budgets: tp.Mapping[tp.Tuple[str, int], tp.Mapping[str, tp.Any]],
+) -> str:
+    """Render the checked-in train traffic cells
+    (:data:`midgpt_tpu.analysis.budgets.TRAIN_BUDGETS`) as the PERF.md
+    markdown table — one row per (mesh geometry, window K) cell, with
+    the ICI/DCN tier split and the per-axis decomposition. Generated
+    from the budget dict itself, so the published numbers can never
+    drift from what CI gates. jax-free."""
+    lines = [
+        "| geometry | K | ICI MB/step | DCN MB/step | by axis |",
+        "|---|---|---|---|---|",
+    ]
+    for (geom, k), cell in sorted(budgets.items()):
+        axes = ", ".join(
+            f"{a}: {b / 1e6:.1f}"
+            for a, b in sorted(cell.get("by_axis", {}).items())
+        )
+        lines.append(
+            f"| {geom} | {k} "
+            f"| {cell['ici_bytes'] / 1e6:.1f} "
+            f"| {cell['dcn_bytes'] / 1e6:.1f} "
+            f"| {axes} |"
+        )
+    return "\n".join(lines)

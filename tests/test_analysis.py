@@ -419,19 +419,33 @@ def test_donation_audit_detects_undonated_jit():
 
 
 def test_train_step_comms_summary_scalars():
-    """The bench.py wiring: a flat scalar summary (total/DCN traffic,
-    collective count) that rides the one-JSON-line BENCH record."""
+    """The bench.py wiring: a flat scalar summary (total/ICI/DCN
+    traffic, collective count, per-axis split, window size) that rides
+    the one-JSON-line BENCH record."""
     from midgpt_tpu.analysis.harness import train_step_comms_summary
 
     s = train_step_comms_summary(_tiny_sharded_cfg())
-    assert set(s) == {
+    fixed = {
         "comms_traffic_bytes_per_step",
+        "comms_ici_bytes_per_step",
         "comms_dcn_bytes_per_step",
         "comms_collective_count",
+        "comms_window_steps",
     }
+    assert fixed <= set(s)
+    # the only other keys are the per-mesh-axis decomposition
+    assert all(
+        k.startswith("comms_axis_") and k.endswith("_bytes_per_step")
+        for k in set(s) - fixed
+    )
     assert s["comms_traffic_bytes_per_step"] > 0  # FSDP/TP traffic exists
     assert s["comms_dcn_bytes_per_step"] == 0  # single slice
+    assert s["comms_ici_bytes_per_step"] == s["comms_traffic_bytes_per_step"]
     assert s["comms_collective_count"] > 0
+    assert s["comms_window_steps"] == 1  # per-step jit, no fused window
+    assert sum(
+        v for k, v in s.items() if k.startswith("comms_axis_")
+    ) == s["comms_traffic_bytes_per_step"]
     json.dumps(s)  # JSON-serializable scalars
 
 

@@ -241,13 +241,43 @@ def test_model_closure_in_serving_flagged():
     assert ("no-model-closure-jit", 8) in _rules(fs)
 
 
-def test_model_closure_outside_serving_not_flagged():
-    """The rule is scoped to midgpt_tpu/serving/ — trainers may close
-    over config-derived structures."""
+def test_model_closure_outside_scoped_files_not_flagged():
+    """The rule covers midgpt_tpu/serving/ plus the train-side jit
+    sites (train.py / bench.py) — other modules may close over
+    config-derived structures."""
     fs = lint_source(
         textwrap.dedent(_CLOSURE_SRC), path="midgpt_tpu/train_probe.py"
     )
     assert [(r, n) for r, n in _rules(fs) if r == "no-model-closure-jit"] == []
+
+
+def test_model_closure_in_train_py_flagged():
+    """train.py's jit sites are in scope: a train program closing over
+    the model would constant-fold the params into the executable and
+    break donation (the PR 6 serving bug class, train-side)."""
+    fs = lint_source(textwrap.dedent(_CLOSURE_SRC), path="midgpt_tpu/train.py")
+    assert ("no-model-closure-jit", 8) in _rules(fs)
+
+
+def test_model_closure_in_bench_py_flagged():
+    fs = lint_source(textwrap.dedent(_CLOSURE_SRC), path="bench.py")
+    assert ("no-model-closure-jit", 8) in _rules(fs)
+
+
+def test_unrolled_layer_loop_rule_stays_serving_scoped():
+    """Extending the closure rule to train.py must NOT drag the
+    layer-loop rule along — train.py's loop structure is gated by the
+    train dispatch budget, not the AST lint."""
+    src = """
+        import jax
+
+        def loss(layers, x):
+            for layer in layers:
+                x = attention(layer, x)
+            return x
+        """
+    fs = lint_source(textwrap.dedent(src), path="midgpt_tpu/train.py")
+    assert [(r, n) for r, n in _rules(fs) if r == "no-unrolled-layer-loop"] == []
 
 
 def test_model_as_parameter_passes():

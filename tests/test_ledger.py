@@ -19,6 +19,7 @@ from midgpt_tpu.analysis.ledger import (
     diff_record,
     load_trajectory,
     markdown_report,
+    parse_multichip_record,
     row_hardware,
     row_kind,
     row_ok,
@@ -364,3 +365,120 @@ def test_serving_rows_compare_only_at_same_offered_load():
         f.key == "serve_tok_s" and f.severity == "hard"
         for f in diff_record(same, _rows(ref))
     )
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP ingestion
+# ---------------------------------------------------------------------------
+
+_MULTICHIP_RAW = {
+    "n_devices": 8,
+    "rc": 0,
+    "ok": True,
+    "skipped": False,
+    "tail": (
+        "dryrun_multichip(8): mesh {'replica': 1, 'fsdp': 2}, "
+        "loss=6.0479 OK\n"
+        "dryrun multi-slice (2 slices over DCN, mesh {'replica': 2}): "
+        "loss=6.0844 OK\n"
+        "dryrun GPT pipeline (4 stages): loss=5.9629 (matches non-PP "
+        "5.9631, diff 2.0e-04) OK\n"
+        "dryrun pipeline(4 stages): loss=330.5806 OK\n"
+    ),
+}
+
+
+def test_multichip_record_parses_tail_losses():
+    rec = parse_multichip_record(_MULTICHIP_RAW)
+    assert row_kind(rec) == "multichip"
+    assert row_ok(rec)
+    assert rec["n_devices"] == 8
+    assert rec["multichip_mesh_loss"] == pytest.approx(6.0479)
+    assert rec["multichip_multi_slice_loss"] == pytest.approx(6.0844)
+    # "GPT pipeline" and the seed-sum "pipeline" line are distinct keys
+    assert rec["multichip_gpt_pipeline_loss"] == pytest.approx(5.9629)
+    assert rec["multichip_pipeline_loss"] == pytest.approx(330.5806)
+
+
+def test_multichip_wedge_row_excluded():
+    """A non-ok/skipped wrapper is a wedge (status='error'), excluded
+    from the reference exactly like the r4/r5 BENCH watchdog rows."""
+    rec = parse_multichip_record({**_MULTICHIP_RAW, "ok": False, "rc": 1})
+    assert not row_ok(rec)
+    rec = parse_multichip_record({**_MULTICHIP_RAW, "skipped": True})
+    assert not row_ok(rec)
+
+
+def test_multichip_loss_drift_is_hard_static():
+    ref = parse_multichip_record(_MULTICHIP_RAW)
+    cur = {**ref, "multichip_multi_slice_loss": 6.5}  # ~7% drift
+    findings = diff_record(cur, _rows(ref))
+    assert any(
+        f.severity == "hard" and f.key == "multichip_multi_slice_loss"
+        for f in findings
+    )
+    # inside the 5% band: clean
+    near = {**ref, "multichip_multi_slice_loss": 6.10}
+    assert diff_record(near, _rows(ref)) == []
+
+
+def test_multichip_rows_compare_only_within_same_device_count():
+    ref = parse_multichip_record(_MULTICHIP_RAW)
+    cur = {**ref, "n_devices": 4, "multichip_mesh_loss": 99.0}
+    assert diff_record(cur, _rows(ref)) == []
+
+
+def test_multichip_inventory_shrink_is_hard():
+    ref = parse_multichip_record(_MULTICHIP_RAW)
+    cur = dict(ref)
+    del cur["multichip_gpt_pipeline_loss"]
+    findings = diff_record(cur, _rows(ref))
+    assert any(
+        f.severity == "hard" and f.key == "multichip_gpt_pipeline_loss"
+        for f in findings
+    )
+
+
+def test_load_trajectory_ingests_multichip_rounds(tmp_path):
+    traj = _write_trajectory(tmp_path, [_HW_TRAIN])
+    d = tmp_path / "traj"
+    (d / "MULTICHIP_r01.json").write_text(json.dumps(_MULTICHIP_RAW))
+    (d / "MULTICHIP_r02.json").write_text(
+        json.dumps({**_MULTICHIP_RAW, "ok": False, "rc": 1})
+    )
+    rows = load_trajectory(str(d))
+    kinds = [row_kind(r.record) for r in rows]
+    assert kinds == ["train", "multichip", "multichip"]
+    # indices continue past the BENCH rounds, in round order
+    assert [r.index for r in rows] == [1, 2, 3]
+    assert row_ok(rows[1].record) and not row_ok(rows[2].record)
+
+
+def test_cli_self_check_covers_multichip_family(capsys):
+    """Acceptance: the shipped MULTICHIP_r*.json rounds join the
+    trajectory, the per-kind self-check diffs the newest OK multichip
+    round against its predecessors, and the whole ledger stays green
+    (train's newest OK row stays the FIRST record — BENCH_r03)."""
+    rc = main(["--ledger"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] is True
+    assert out["trajectory_rows"] >= 10
+    assert "BENCH_r03" in out["records"][0]
+    assert any("MULTICHIP_r05" in r for r in out["records"])
+
+
+def test_multichip_trend_section_in_report(tmp_path, capsys):
+    traj = _write_trajectory(tmp_path, [_HW_TRAIN])
+    d = tmp_path / "traj"
+    (d / "MULTICHIP_r01.json").write_text(json.dumps(_MULTICHIP_RAW))
+    report = str(tmp_path / "report.md")
+    rc = main([
+        "--ledger", "--trajectory", str(d),
+        "--record", _write_record(tmp_path, _HW_TRAIN),
+        "--report", report,
+    ])
+    assert rc == 0
+    text = open(report).read()
+    assert "## multichip trajectory" in text
+    assert "6.084" in text  # multichip_multi_slice_loss column
+    capsys.readouterr()

@@ -1,0 +1,397 @@
+"""Train-window traffic + dispatch gates (the --train-audit family).
+
+Three layers, cheapest first:
+
+- **Fixture tests** (milliseconds, no jax tracing): a hand-written
+  2-slice train-window HLO pair under ``tests/fixtures/`` with
+  hand-computed wire bytes drives ``cost.py``'s
+  ``collective_crosses_slice`` ICI/DCN split and ``check_train_budget``
+  — including the cross-slice-re-gather fault, whose only symptom is
+  FSDP gather bytes migrating from the ICI tier to DCN.
+- **Checker unit tests** (jax-free dict/dataclass inputs) for
+  ``check_train_budget`` / ``check_train_dispatch_budget`` /
+  ``train_geometry_key`` and the K-invariance of the checked-in cells.
+- **Compile/trace-backed tests** against the real fused window at the
+  audit geometry: the fsdp and dcn2 K=1 cells must match the
+  checked-in budgets exactly, and each injected fault must fail ONLY
+  its own gate (cross-slice re-gather -> traffic; re-unrolled
+  grad-accum scan -> dispatch) while the other gates stay green.
+"""
+
+import pathlib
+
+import pytest
+
+from midgpt_tpu.analysis import MeshInfo, StepAnalysis, cost_report
+from midgpt_tpu.analysis.budgets import (
+    TRAIN_AUDIT_GEOMETRIES,
+    TRAIN_BUDGETS,
+    check_train_budget,
+    check_train_dispatch_budget,
+    train_budget_for,
+    train_geometry_key,
+)
+from midgpt_tpu.analysis.dispatch import TrainDispatchReport
+from midgpt_tpu.analysis.traffic import train_budget_table_markdown
+from midgpt_tpu.config import get_config
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+# the fixtures' mesh: 8 devices as (pipeline, replica, fsdp, seq, tensor),
+# replica split across 2 slices (slice id == replica coordinate)
+MESH_2SLICE = MeshInfo(
+    axis_names=("pipeline", "replica", "fsdp", "sequence", "tensor"),
+    axis_sizes=(1, 2, 2, 1, 2),
+    num_slices=2,
+)
+
+# hand-computed budget for train_multislice_window.hlo (ring arithmetic):
+#   bf16[16,32] fsdp param all-gather, g=2:  16*32*2 * 1/2 =  512 B (ICI)
+#   f32[16,32] fsdp grad reduce-scatter g=2: 16*32*4 * 1/2 = 1024 B (ICI)
+#   f32[8,32] cross-slice all-reduce g=2:  2* 8*32*4 * 1/2 = 1024 B (DCN)
+FIXTURE_BUDGET = {
+    "ici_bytes": 1536,
+    "dcn_bytes": 1024,
+    "by_axis": {"fsdp": 1536, "replica": 1024},
+}
+
+
+def _fixture_report(name: str):
+    a = StepAnalysis.from_text(
+        (FIXTURES / name).read_text(), MESH_2SLICE, global_batch=8, block=256
+    )
+    return cost_report(a)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: the collective_crosses_slice split, no compilation
+# ---------------------------------------------------------------------------
+
+
+def test_train_window_fixture_matches_hand_computed_bytes():
+    rep = _fixture_report("train_multislice_window.hlo")
+    assert rep["value"] == 2560
+    assert rep["ici_bytes"] == 1536
+    assert rep["dcn_bytes"] == 1024
+    assert rep["by_axis"] == {"fsdp": 1536, "replica": 1024}
+    media = [(c["kind"], c["medium"]) for c in rep["collectives"]]
+    assert media == [
+        ("all-gather", "ici"),
+        ("reduce-scatter", "ici"),
+        ("all-reduce", "dcn"),
+    ]
+
+
+def test_cross_slice_gather_fault_moves_bytes_to_dcn():
+    """The bad fixture's only change: the fsdp param gather's groups
+    span both slices ({{0,2,4,6},{1,3,5,7}}), so its bytes grow
+    (g=2 -> g=4 over a doubled result) AND land on DCN under the
+    replica+fsdp axis pair — the exact signature the compiled fault
+    test below reproduces on a real mesh."""
+    rep = _fixture_report("train_multislice_badgather.hlo")
+    assert rep["ici_bytes"] == 1024
+    assert rep["dcn_bytes"] == 2560
+    assert rep["by_axis"] == {
+        "replica+fsdp": 1536, "fsdp": 1024, "replica": 1024,
+    }
+    gather = rep["collectives"][0]
+    assert gather["kind"] == "all-gather"
+    assert gather["medium"] == "dcn"
+    assert gather["mesh_axes"] == ["replica", "fsdp"]
+
+
+def test_check_train_budget_green_on_good_fixture():
+    assert check_train_budget(
+        _fixture_report("train_multislice_window.hlo"),
+        FIXTURE_BUDGET,
+        geometry="fixture2slice",
+    ) == []
+
+
+def test_check_train_budget_flags_cross_slice_regather():
+    vs = check_train_budget(
+        _fixture_report("train_multislice_badgather.hlo"),
+        FIXTURE_BUDGET,
+        geometry="fixture2slice",
+    )
+    joined = " | ".join(vs)
+    assert any("dcn_bytes" in v for v in vs), vs
+    assert "unexpected collective axis 'replica+fsdp'" in joined
+    # the gather's ICI bytes vanished too — bands work both ways
+    assert any("axis 'fsdp'" in v for v in vs), vs
+
+
+def test_zero_dcn_budget_trips_on_a_single_byte():
+    vs = check_train_budget(
+        {"ici_bytes": 1000, "dcn_bytes": 1, "by_axis": {"fsdp": 1000}},
+        {"ici_bytes": 1000, "dcn_bytes": 0, "by_axis": {"fsdp": 1000}},
+    )
+    assert len(vs) == 1 and "cross-slice re-gather" in vs[0]
+
+
+# ---------------------------------------------------------------------------
+# checker units (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_report(**over):
+    kw = dict(
+        program="train_window",
+        window_steps=4,
+        g_accum_iters=2,
+        window_scan_length=4,
+        accum_scan_length=2,
+        accum_carry_leaves=9,
+        host_transfers=0,
+    )
+    kw.update(over)
+    return TrainDispatchReport(**kw)
+
+
+def test_dispatch_budget_green():
+    rep = _dispatch_report()
+    assert rep.launches_per_window == 1
+    assert check_train_dispatch_budget(rep, aliased_leaves=27) == []
+
+
+def test_dispatch_budget_flags_lost_window_scan():
+    rep = _dispatch_report(window_scan_length=0)
+    assert rep.launches_per_window == 4
+    vs = check_train_dispatch_budget(rep, aliased_leaves=27)
+    assert len(vs) == 1 and "dispatch latency" in vs[0]
+
+
+def test_dispatch_budget_flags_reunrolled_accum():
+    vs = check_train_dispatch_budget(
+        _dispatch_report(accum_scan_length=0), aliased_leaves=27
+    )
+    assert len(vs) == 1 and "re-unrolled" in vs[0]
+
+
+def test_dispatch_budget_flags_host_transfer_and_lost_donation():
+    vs = check_train_dispatch_budget(
+        _dispatch_report(host_transfers=2), aliased_leaves=19
+    )
+    joined = " | ".join(vs)
+    assert "host callback" in joined and "HBM residency" in joined
+
+
+def test_train_geometry_key_reverse_lookup():
+    assert train_geometry_key(
+        dict(replica=1, fsdp=8, sequence=1, tensor=1)
+    ) == "fsdp"
+    assert train_geometry_key(
+        dict(replica=2, fsdp=4, sequence=1, tensor=1, num_slices=2)
+    ) == "dcn2"
+    # a 2-slice shape WITHOUT the num_slices marker is not dcn2
+    assert train_geometry_key(dict(replica=2, fsdp=4)) is None
+    assert train_geometry_key(dict(fsdp=2, tensor=4)) is None
+
+
+def test_train_budget_cells_are_k_invariant():
+    """cost.py counts a scan-body collective once per dispatch, so the
+    fused window's static bytes must NOT grow with K — the checked-in
+    cells pin that identity."""
+    for geom in TRAIN_AUDIT_GEOMETRIES:
+        assert TRAIN_BUDGETS[(geom, 1)] == TRAIN_BUDGETS[(geom, 4)], geom
+    assert train_budget_for("fsdp", 1) is TRAIN_BUDGETS[("fsdp", 1)]
+    assert train_budget_for("fsdp", 3) is None
+
+
+def test_train_budget_table_renders_all_cells():
+    md = train_budget_table_markdown(TRAIN_BUDGETS)
+    lines = md.splitlines()
+    assert lines[0].startswith("| geometry | K |")
+    assert len(lines) == 2 + len(TRAIN_BUDGETS)
+    assert any(l.startswith("| dcn2 | 1 ") and "14.2" in l for l in lines)
+    assert any(l.startswith("| fsdp | 4 ") for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# compile/trace-backed: real window at the audit geometry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_cfg():
+    return get_config("openwebtext")
+
+
+def test_fsdp_cell_matches_checked_in_budget(base_cfg):
+    from midgpt_tpu.analysis.harness import train_traffic_cell
+
+    cell = train_traffic_cell(base_cfg, "fsdp", 1)
+    assert check_train_budget(
+        cell, train_budget_for("fsdp", 1), geometry="fsdp"
+    ) == []
+    assert cell["dcn_bytes"] == 0
+    # donation accounting off the same executable: every donated train
+    # state leaf is input/output-aliased
+    assert cell["aliased_leaves"] == cell["donated_leaves"] == 27
+    assert check_train_dispatch_budget(
+        _dispatch_report(window_steps=1, window_scan_length=1),
+        aliased_leaves=cell["aliased_leaves"],
+    ) == []
+
+
+def test_dcn2_cell_matches_checked_in_budget(base_cfg):
+    from midgpt_tpu.analysis.harness import train_traffic_cell
+
+    cell = train_traffic_cell(base_cfg, "dcn2", 1)
+    assert check_train_budget(
+        cell, train_budget_for("dcn2", 1), geometry="dcn2"
+    ) == []
+    # the 2-slice mesh has real DCN traffic — and only on the grad-sync
+    # axes, never the fsdp param gathers
+    assert cell["dcn_bytes"] > 0
+    assert set(cell["by_axis"]) == {"fsdp", "replica+fsdp", "replica"}
+
+
+def test_cross_slice_regather_fault_trips_traffic_gate_only(base_cfg):
+    """Widen every fsdp param axis to (replica, fsdp) on the dcn2 mesh:
+    GSPMD re-gathers params across the slice boundary, so the gather
+    bytes move wholesale from ICI to DCN (the fixture fault, on a real
+    compile). The traffic gate must go red; the choreography prover and
+    the dispatch gate — which see dtypes and launch structure, both
+    untouched — must stay green."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from midgpt_tpu.analysis.cost import cost_report as cost
+    from midgpt_tpu.analysis.dispatch import train_dispatch_report
+    from midgpt_tpu.analysis.harness import (
+        compile_train_window,
+        shrink_for_train_audit,
+    )
+    from midgpt_tpu.analysis.train_choreo import prove_window_choreography
+    from midgpt_tpu.models.gpt import gpt_param_rules
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.train import init_state, make_optimizer, make_train_window
+
+    def widen(spec):
+        return P(*[
+            ("replica", "fsdp") if a == "fsdp" else a for a in spec
+        ])
+
+    bad_rules = tuple(
+        (pat, widen(spec)) for pat, spec in gpt_param_rules()
+    )
+    audit = shrink_for_train_audit(base_cfg, "dcn2")
+
+    hlo, mesh, donated, aliased = compile_train_window(
+        audit, 1, param_rules=bad_rules
+    )
+    rep = cost(StepAnalysis.from_text(
+        hlo,
+        MeshInfo.from_mesh(mesh, num_slices=audit.mesh.num_slices),
+        global_batch=audit.batch_size,
+        block=audit.model.block_size,
+    ))
+    vs = check_train_budget(
+        rep, train_budget_for("dcn2", 1), geometry="dcn2"
+    )
+    assert vs, "widened param specs must trip the traffic gate"
+    assert any("dcn_bytes" in v for v in vs), vs
+    # the fsdp-only gathers are gone: their ICI bytes vanished
+    assert rep["dcn_bytes"] > train_budget_for("dcn2", 1)["dcn_bytes"]
+
+    # ...while the other two gates stay green on the same faulty window
+    tx, _ = make_optimizer(audit)
+    state = init_state(
+        audit, mesh, tx, jax.random.PRNGKey(0), abstract=True,
+        param_rules=bad_rules,
+    )
+    prog = make_train_window(audit, tx, mesh, 1, param_rules=bad_rules)
+    xs = jax.ShapeDtypeStruct(
+        (1, audit.g_accum_iters, audit.microbatch_size,
+         audit.model.block_size),
+        jnp.int32,
+    )
+    key = jax.random.PRNGKey(1)
+    closed = jax.make_jaxpr(prog)(state, xs, xs, key)
+    out_tree = jax.eval_shape(prog, state, xs, xs, key)
+    prover = prove_window_choreography(
+        closed, out_tree, window_steps=1,
+        g_accum_iters=audit.g_accum_iters,
+    )
+    assert prover.ok, prover.to_dict()
+    disp = train_dispatch_report(
+        closed, window_steps=1, g_accum_iters=audit.g_accum_iters
+    )
+    assert check_train_dispatch_budget(disp, aliased_leaves=aliased) == []
+
+
+def test_reunrolled_accum_fault_trips_dispatch_gate_only(
+    base_cfg, monkeypatch
+):
+    """Unroll ONLY the grad-accum scan (its carry signature — a 2-tuple
+    of (grad tree, f32 scalar loss accumulator) — identifies it; the
+    window scan carries a TrainState, the layer scan a single array).
+    The dispatch gate must flag accum_scan_length 0 with the re-unroll
+    hint; the choreography prover DEFERS (its grad-accum clause reports
+    'no grad-accum scan in trace') rather than double-reporting."""
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.analysis.dispatch import train_dispatch_report
+    from midgpt_tpu.analysis.harness import (
+        shrink_for_train_audit,
+        trace_train_window,
+    )
+    from midgpt_tpu.analysis.train_choreo import prove_window_choreography
+
+    real_scan = jax.lax.scan
+
+    def unrolling_scan(f, init, xs=None, **kw):
+        is_accum = (
+            isinstance(init, tuple)
+            and len(init) == 2
+            and hasattr(init[1], "dtype")
+            and str(getattr(init[1], "dtype", "")) == "float32"
+            and getattr(init[1], "shape", None) == ()
+        )
+        if not is_accum:
+            return real_scan(f, init, xs, **kw)
+        carry = init
+        for i in range(jax.tree.leaves(xs)[0].shape[0]):
+            carry, _ = f(carry, jax.tree.map(lambda a: a[i], xs))
+        return carry, None
+
+    monkeypatch.setattr(jax.lax, "scan", unrolling_scan)
+
+    audit = shrink_for_train_audit(base_cfg, "fsdp")
+    # use_cache=False: the poisoned trace must not land in the shared
+    # train.get_train_window cache other tests resolve through
+    closed, out_tree = trace_train_window(audit, 4, use_cache=False)
+    disp = train_dispatch_report(
+        closed, window_steps=4, g_accum_iters=audit.g_accum_iters
+    )
+    assert disp.accum_scan_length == 0
+    assert disp.window_scan_length == 4  # the window scan survived
+    vs = check_train_dispatch_budget(disp, aliased_leaves=27)
+    assert len(vs) == 1 and "re-unrolled" in vs[0], vs
+
+    prover = prove_window_choreography(
+        closed, out_tree, window_steps=4,
+        g_accum_iters=audit.g_accum_iters,
+    )
+    by_name = {c.name: c for c in prover.checks}
+    accum = by_name["grad-accum-carry"]
+    assert accum.ok and "no grad-accum scan in trace" in accum.detail
+    assert prover.ok, prover.to_dict()
+
+
+@pytest.mark.slow
+def test_audit_train_full_matrix(base_cfg):
+    """The whole CI matrix in one test: all three geometries, K=1 and
+    K=4 — prover + traffic + dispatch green everywhere."""
+    from midgpt_tpu.analysis.harness import audit_train
+
+    for geom in TRAIN_AUDIT_GEOMETRIES:
+        report = audit_train(base_cfg, geom)
+        assert report["ok"], (geom, report["violations"])
+        assert [c["window_steps"] for c in report["cells"]] == [1, 4]
+        for cell in report["cells"]:
+            assert cell["choreography"]["ok"]
+            assert cell["dispatch"]["launches_per_window"] == 1

@@ -1,0 +1,232 @@
+"""Mixed-precision choreography prover for the fused train window.
+
+Scan-discovery units on tiny hand-built jaxprs, then the real proof:
+the cached ``train.get_train_window`` trace at every audit geometry
+must satisfy all seven contract clauses — and each injected precision
+fault (bf16 Adam moments, f32 matmul operands) must fail EXACTLY its
+own clause while every other clause stays green. Traces only, no XLA
+compilation: the whole file runs in seconds.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from midgpt_tpu.analysis.budgets import TRAIN_AUDIT_GEOMETRIES
+from midgpt_tpu.analysis.train_choreo import (
+    ScanRec,
+    collapse_dot_kinds,
+    find_accum_scan,
+    find_window_scan,
+    prove_window_choreography,
+    window_scans,
+)
+from midgpt_tpu.config import get_config
+
+CHECK_NAMES = {
+    "matmul-compute-dtype",
+    "master-params-dtype",
+    "adam-moments-dtype",
+    "softmax-loss-f32",
+    "grad-accum-carry",
+    "window-scan-carry",
+    "remat-recompute",
+}
+
+
+# ---------------------------------------------------------------------------
+# scan discovery on hand-built jaxprs
+# ---------------------------------------------------------------------------
+
+
+def test_window_scans_depth_annotation():
+    def inner(c, x):
+        return c + x, x
+
+    def outer(c, xs):
+        c2, _ = jax.lax.scan(inner, c, xs)
+        return c2, c2
+
+    def prog(c, xss):
+        return jax.lax.scan(outer, c, xss)
+
+    closed = jax.make_jaxpr(prog)(
+        jnp.zeros(()), jnp.zeros((4, 3))
+    )
+    scans = window_scans(closed)
+    assert [(s.depth, s.length) for s in scans] == [(0, 4), (1, 3)]
+    assert scans[0].carry_dtypes == ("float32",)
+    assert scans[0].carry_shapes == ((),)
+
+
+def test_window_scans_sees_through_pjit():
+    """Call-like wrappers (jit) are depth-transparent: a scan inside a
+    nested jit still reports depth 0."""
+
+    @jax.jit
+    def wrapped(c, xs):
+        return jax.lax.scan(lambda c, x: (c + x, x), c, xs)
+
+    closed = jax.make_jaxpr(lambda c, xs: wrapped(c, xs))(
+        jnp.zeros(()), jnp.zeros((5,))
+    )
+    scans = window_scans(closed)
+    assert [(s.depth, s.length) for s in scans] == [(0, 5)]
+
+
+def test_find_window_scan_requires_int32_scalar_carry():
+    opt = ScanRec(
+        depth=0, length=4,
+        carry_dtypes=("float32", "int32", "float32"),
+        carry_shapes=((8, 8), (), (8,)),
+    )
+    data = ScanRec(
+        depth=0, length=4,
+        carry_dtypes=("float32",), carry_shapes=((8, 8),),
+    )
+    assert find_window_scan([data, opt], 4) is opt
+    assert find_window_scan([data], 4) is None
+    # wrong length: a layer scan of trip 4 is not the K=8 window
+    assert find_window_scan([opt], 8) is None
+
+
+def test_find_accum_scan_discriminates_layer_scan():
+    layer = ScanRec(
+        depth=1, length=2,
+        carry_dtypes=("bfloat16",), carry_shapes=((2, 256, 64),),
+    )
+    accum = ScanRec(
+        depth=1, length=2,
+        carry_dtypes=("bfloat16", "bfloat16", "bfloat16", "float32"),
+        carry_shapes=((8, 8), (8,), (8, 8), ()),
+    )
+    assert find_accum_scan([layer, accum], True) is accum
+    # without a window scan the accum scan sits at depth 0
+    assert find_accum_scan([layer, accum], False) is None
+
+
+def test_collapse_dot_kinds_folds_projection_flavors():
+    assert collapse_dot_kinds(("rope", ("bfloat16",), ("bfloat16",))) == (
+        "dot", ("bfloat16",), ("bfloat16",)
+    )
+    assert collapse_dot_kinds(("exp", ("float32",), ("float32",)))[0] == "exp"
+
+
+# ---------------------------------------------------------------------------
+# the real window: green on every audit geometry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_cfg():
+    return get_config("openwebtext")
+
+
+@pytest.mark.parametrize("geometry", sorted(TRAIN_AUDIT_GEOMETRIES))
+def test_prover_green_on_cached_window(base_cfg, geometry):
+    from midgpt_tpu.analysis.harness import prove_train_window_choreography
+
+    report = prove_train_window_choreography(base_cfg, geometry, 1)
+    assert report.ok, report.to_dict()
+    by_name = {c.name: c for c in report.checks}
+    assert set(by_name) == CHECK_NAMES
+    # no vacuous pass: the grad-accum clause must have FOUND the scan
+    # (deferral to the dispatch gate reads "no grad-accum scan")
+    assert by_name["grad-accum-carry"].detail.startswith("found:")
+    assert by_name["window-scan-carry"].detail.startswith(
+        "window scan length=1"
+    )
+    assert report.programs == ("train_window", "train_window+remat")
+
+
+def test_prover_green_at_k4(base_cfg):
+    from midgpt_tpu.analysis.harness import prove_train_window_choreography
+
+    report = prove_train_window_choreography(base_cfg, "fsdp", 4)
+    assert report.ok, report.to_dict()
+    by_name = {c.name: c for c in report.checks}
+    assert "length=4" in by_name["window-scan-carry"].detail
+
+
+# ---------------------------------------------------------------------------
+# fault injection: each bug class fails exactly its own clause
+# ---------------------------------------------------------------------------
+
+
+def _trace_fsdp_window(cfg, tx=None):
+    from midgpt_tpu.analysis.harness import (
+        shrink_for_train_audit,
+        trace_train_window,
+    )
+
+    audit = shrink_for_train_audit(cfg, "fsdp")
+    return audit, trace_train_window(audit, 1, tx=tx, use_cache=False)
+
+
+def _assert_only_red(report, bad_name):
+    by_name = {c.name: c for c in report.checks}
+    assert not by_name[bad_name].ok, by_name[bad_name]
+    green = {n: c.ok for n, c in by_name.items() if n != bad_name}
+    assert all(green.values()), green
+    return by_name[bad_name]
+
+
+def test_bf16_moments_fault_trips_only_adam_clause(base_cfg):
+    """optax.scale_by_adam(mu_dtype=bfloat16) — the classic silent
+    half-precision first moment. Only adam-moments-dtype may go red:
+    matmuls, param masters, loss dtype and scan carries are all still
+    correct."""
+    from midgpt_tpu.analysis.harness import shrink_for_train_audit
+    from midgpt_tpu.train import make_lr_schedule
+
+    audit = shrink_for_train_audit(base_cfg, "fsdp")
+    wd = (
+        audit.weight_decay / audit.learning_rate
+        if getattr(audit, "independent_wd", False)
+        else audit.weight_decay
+    )
+    tx_bad = optax.chain(
+        optax.clip_by_global_norm(audit.grad_clip),
+        optax.scale_by_adam(
+            b1=audit.beta1, b2=audit.beta2, mu_dtype=jnp.bfloat16
+        ),
+        optax.add_decayed_weights(wd),
+        optax.scale_by_schedule(make_lr_schedule(audit)),
+        optax.scale(-1.0),
+    )
+    _, (closed, out_tree) = _trace_fsdp_window(base_cfg, tx=tx_bad)
+    report = prove_window_choreography(
+        closed, out_tree, window_steps=1,
+        g_accum_iters=audit.g_accum_iters,
+    )
+    bad = _assert_only_red(report, "adam-moments-dtype")
+    assert "mu_dtype bug class" in bad.detail
+    assert "bfloat16" in bad.detail
+
+
+def test_f32_matmul_fault_trips_only_matmul_clause(base_cfg, monkeypatch):
+    """Skip the cast_floating boundary inside the loss: every weight
+    dot now runs on f32 operands (double the FLOP bytes, no accuracy
+    win). Only matmul-compute-dtype may go red — the master params,
+    moments and loss accumulation are still f32 as required."""
+    from midgpt_tpu import train as train_mod
+    from midgpt_tpu.analysis.harness import shrink_for_train_audit
+    from midgpt_tpu.pytree import cast_floating
+
+    orig_loss_fn = train_mod.loss_fn
+
+    def f32_loss_fn(model, *args, **kw):
+        return orig_loss_fn(
+            cast_floating(model, jnp.float32), *args, **kw
+        )
+
+    monkeypatch.setattr(train_mod, "loss_fn", f32_loss_fn)
+    audit = shrink_for_train_audit(base_cfg, "fsdp")
+    _, (closed, out_tree) = _trace_fsdp_window(base_cfg)
+    report = prove_window_choreography(
+        closed, out_tree, window_steps=1,
+        g_accum_iters=audit.g_accum_iters,
+    )
+    bad = _assert_only_red(report, "matmul-compute-dtype")
+    assert "non-bfloat16 float operands" in bad.detail
