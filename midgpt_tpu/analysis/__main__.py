@@ -115,6 +115,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 4)",
     )
     p.add_argument(
+        "--serving-spec-sampled", action="store_true",
+        help="with --serving: audit the speculative verify program a "
+        "SECOND time at temperature 0.8 / top_k 20 — the rejection-"
+        "sampling acceptance path (engine.py). The donation and "
+        "no-host-sync rules apply unchanged, and with --traffic the "
+        "sampled program gates against the SAME verify_program budget "
+        "cells: the sampled wrapper appends only the per-slot seeds "
+        "and the PRNG key (control scalars) to the entry interface, "
+        "so any dense draft-probability stream joining the dispatch "
+        "trips the unclassified-float rule.",
+    )
+    p.add_argument(
         "--choreo", action="store_true",
         help="with --serving: run the arithmetic-choreography prover "
         "(analysis.choreo) over the three serving programs, bf16 AND "
@@ -123,8 +135,14 @@ def build_parser() -> argparse.ArgumentParser:
         "mirrors decode op-for-op, the prefill chunk mirrors "
         "naive_attention's softmax core, and the shared arithmetic "
         "(f32 softmax/accumulation, mask-before-scale, one lm-head "
-        "choreography) holds everywhere. The machine check for the "
-        "PR 4/PR 5 bf16 argmax-flip bug class.",
+        "choreography) holds everywhere. Each cell is then proven "
+        "AGAIN at temperature 0.8 / top_k 20 ('<cell>/sampled'): the "
+        "verify program's row-0 sampler must mirror the decode "
+        "window's categorical op-for-op, and the rejection-sampling "
+        "acceptance compares / residual renormalization / target "
+        "softmax must all run in f32. The machine check for the "
+        "PR 4/PR 5 bf16 argmax-flip bug class, extended to the "
+        "sampled acceptance rule.",
     )
     p.add_argument(
         "--traffic", action="store_true",
@@ -410,18 +428,29 @@ def _run_choreo(args, cfg):
             # contract rides along at ~zero cost — the kernel body's
             # softmax signature must equal the decode window's
             for backend in ("xla", "pallas"):
-                rep = prove_serving_choreography(
-                    cfg, quant=(precision == "int8"), kv_quant=kvq,
-                    paged_kernel=backend,
-                )
-                tag = f"{precision_key(precision, kvq)}/{backend}"
-                out[tag] = rep.to_dict()
-                ok = ok and rep.ok
-                violations.extend(
-                    f"[choreo/{tag}] {c.name}: {c.detail}"
-                    for c in rep.checks
-                    if not c.ok
-                )
+                cell = f"{precision_key(precision, kvq)}/{backend}"
+                # each cell is proven twice: greedy (the PR 4/PR 5
+                # argmax choreography) and sampled (temperature > 0:
+                # the verify row-0 sampler must mirror the decode
+                # window's categorical, and the rejection-sampling
+                # acceptance/residual/target-softmax arithmetic must
+                # run in f32 — choreo.prove_sampled_choreography)
+                for tag, kw in (
+                    (cell, {}),
+                    (f"{cell}/sampled",
+                     dict(temperature=0.8, top_k=20)),
+                ):
+                    rep = prove_serving_choreography(
+                        cfg, quant=(precision == "int8"), kv_quant=kvq,
+                        paged_kernel=backend, **kw
+                    )
+                    out[tag] = rep.to_dict()
+                    ok = ok and rep.ok
+                    violations.extend(
+                        f"[choreo/{tag}] {c.name}: {c.detail}"
+                        for c in rep.checks
+                        if not c.ok
+                    )
     return out, ok, violations
 
 
@@ -507,6 +536,21 @@ def _run_serving(args, cfg, mesh_shape) -> int:
             page_size=args.serving_page_size,
         ), 1),
     )
+    if args.serving_spec_sampled:
+        # the rejection-sampling verify leg: same program geometry at
+        # temperature > 0. It gates against the SAME verify_program
+        # budget cells — the sampled wrapper appends only the per-slot
+        # seeds and the PRNG key (control scalars), so the weight/KV/
+        # logits streams must land byte-identical to the greedy audit
+        # and any dense draft-probability tensor joining the entry
+        # interface trips the unclassified-float rule
+        program_specs = program_specs + (
+            ("verify_program_sampled", audit_verify_program, dict(
+                slots=args.serving_slots, spec_len=args.serving_spec_len,
+                page_size=args.serving_page_size,
+                temperature=0.8, top_k=20,
+            ), 1),
+        )
 
     # --traffic budget gating applies only at the geometry the budgets
     # were measured at (analysis/budgets.AUDIT_GEOMETRY)
@@ -571,11 +615,21 @@ def _run_serving(args, cfg, mesh_shape) -> int:
                 # 'both' — the convention the checked-in cells were
                 # measured with); letting the fused leg overwrite it
                 # would regenerate cells from fused numbers exactly
-                # when the two legs diverge
-                if ls == _layer_scan_modes(args)[0]:
+                # when the two legs diverge. The sampled verify leg is
+                # excluded: it gates against (and must match) the
+                # greedy verify_program cells, it does not get its own
+                if (
+                    ls == _layer_scan_modes(args)[0]
+                    and name != "verify_program_sampled"
+                ):
                     budget_fragment[(name, pkey)] = traf
+                budget_name = (
+                    "verify_program"
+                    if name == "verify_program_sampled"
+                    else name
+                )
                 budget = (
-                    budget_for(name, pkey, budget_geom)
+                    budget_for(budget_name, pkey, budget_geom)
                     if budget_geom
                     else None
                 )
@@ -629,6 +683,7 @@ def _run_serving(args, cfg, mesh_shape) -> int:
             "steps_per_dispatch": k,
             "page_size": args.serving_page_size,
             "spec_len": args.serving_spec_len,
+            "spec_sampled": bool(args.serving_spec_sampled),
             "mesh_shape": mesh_shape,
         },
         "programs": sections,

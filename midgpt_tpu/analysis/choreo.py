@@ -44,6 +44,16 @@ the value dtype; ``/ sqrt(c)`` vs ``* (1/sqrt(c))``), which is exactly
 why a verify program that drifts toward the prefill flavor is a bug the
 full-sequence check catches.
 
+At ``temperature > 0`` the same discipline extends past the attention
+stack into the SAMPLER (:func:`extract_sampler_choreography` /
+:func:`prove_sampled_choreography`): the verify program's row-0
+categorical (softmax -> temperature -> key-derived gumbel argmax) must
+mirror the decode window's op for op, the rejection-sampling acceptance
+compare ``u * q(t) <= p(t)`` must run in f32 (a bf16 compare flips
+near-tie accept/reject decisions the same way the PR 5 bf16 argmax
+flipped near-tie acceptance), and the residual renormalization
+``max(p - q, 0)`` with its target softmax must run in f32.
+
 Everything here operates on jaxprs (no compilation, no execution) — a
 full three-program proof runs in seconds on CPU. jax is imported at
 module level; the CLI imports this module only after platform setup
@@ -297,12 +307,14 @@ def _dot_kind(op: Op) -> str:
     return "dot"
 
 
-def normalized_trace(graph: FlatGraph) -> tp.List[TraceRec]:
-    """The program's float arithmetic as (kind, in_dtypes, out_dtypes)
-    records in program order — the 'op-and-dtype trace'. Shapes are
-    deliberately absent (decode is T=1, verify T=spec+1, a chunk T=N;
-    the choreography contract is about dtypes and order, not widths)."""
-    out: tp.List[TraceRec] = []
+def _trace_pairs(
+    graph: FlatGraph,
+) -> tp.List[tp.Tuple[TraceRec, Op]]:
+    """(record, op) pairs of the program's float arithmetic in program
+    order — the op reference lets region extraction ask structural
+    questions (is this exp an attention softmax?) that the record's
+    dtypes alone cannot answer."""
+    out: tp.List[tp.Tuple[TraceRec, Op]] = []
     for op in graph.ops:
         if op.prim == "paged_kernel":
             # the kernel contract node: float/int8 operand dtypes as a
@@ -314,13 +326,21 @@ def normalized_trace(graph: FlatGraph) -> tp.List[TraceRec]:
                 d for d in op.in_dtypes
                 if d in _FLOAT_DTYPES or d == "int8"
             ))
-            out.append(("paged_kernel", kept, op.out_dtypes))
+            out.append((("paged_kernel", kept, op.out_dtypes), op))
             continue
         if op.prim not in _ARITH or not _is_float_op(op):
             continue
         kind = _dot_kind(op) if op.prim == "dot_general" else op.prim
-        out.append((kind, op.in_dtypes, op.out_dtypes))
+        out.append(((kind, op.in_dtypes, op.out_dtypes), op))
     return out
+
+
+def normalized_trace(graph: FlatGraph) -> tp.List[TraceRec]:
+    """The program's float arithmetic as (kind, in_dtypes, out_dtypes)
+    records in program order — the 'op-and-dtype trace'. Shapes are
+    deliberately absent (decode is T=1, verify T=spec+1, a chunk T=N;
+    the choreography contract is about dtypes and order, not widths)."""
+    return [rec for rec, _ in _trace_pairs(graph)]
 
 
 def attention_regions(graph: FlatGraph) -> tp.List[tp.List[TraceRec]]:
@@ -329,11 +349,10 @@ def attention_regions(graph: FlatGraph) -> tp.List[tp.List[TraceRec]]:
     the inter-'proj' region containing that layer's joint softmax (its
     ``exp``). One region per transformer layer; programs traced at the
     same depth must produce the same number of regions."""
-    trace = normalized_trace(graph)
     regions: tp.List[tp.List[TraceRec]] = []
     current: tp.List[TraceRec] = []
     has_exp = False
-    for rec in trace:
+    for rec, op in _trace_pairs(graph):
         if rec[0] == "proj":
             if has_exp:
                 regions.append(current)
@@ -341,11 +360,18 @@ def attention_regions(graph: FlatGraph) -> tp.List[tp.List[TraceRec]]:
             has_exp = False
             continue
         current.append(rec)
-        if rec[0] in ("exp", "paged_kernel"):
+        if rec[0] == "paged_kernel":
             # a paged_kernel node IS the layer's joint softmax (the exp
             # lives in the kernel body, proven separately)
             has_exp = True
-    if has_exp:  # trailing region (no proj after — not the case today)
+        elif rec[0] == "exp" and _is_attention_exp(graph, op):
+            # ONLY an attention softmax flags a region: the sampled
+            # verify program's target softmax (sampling.target_probs
+            # over the lm-head logits, temperature > 0) also trails an
+            # exp, but that is sampler arithmetic with its own prover
+            # (prove_sampled_choreography), not an attention layer
+            has_exp = True
+    if has_exp:  # trailing region (the bare naive_attention reference)
         regions.append(current)
     return regions
 
@@ -443,6 +469,23 @@ def _leads_to_contract(
                 return True
             continue
         stack.extend(i for i in op.in_ids if i >= 0)
+    return False
+
+
+def _is_attention_exp(graph: FlatGraph, exp_op: Op) -> bool:
+    """Is this ``exp`` an attention softmax? Its backward slice (stopping
+    at contraction boundaries) then contains the QK score contraction —
+    a data-data ``dot`` or a ``reduce_sum``-over-``mul``. A SAMPLER
+    softmax (``sampling.target_probs`` over the lm-head logits in the
+    temperature>0 verify program) stops at the lm-head weight projection
+    instead and has neither."""
+    for op in _backward_ops(graph, [i for i in exp_op.in_ids if i >= 0]):
+        if op.prim == "dot_general" and _dot_kind(op) == "dot":
+            return True
+        if op.prim == "reduce_sum":
+            src = graph.producer.get(op.in_ids[0])
+            if src is not None and src.prim == "mul":
+                return True
     return False
 
 
@@ -670,6 +713,7 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
         exps = [
             op for op in graph.ops
             if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+            and _is_attention_exp(graph, op)
         ]
         sig = softmax_signature(graph, exps[0])
         for e in exps[1:]:
@@ -901,3 +945,240 @@ def prove_choreography(
         checks=tuple(checks),
         programs=(decode, prefill, verify, naive),
     )
+
+
+# ---------------------------------------------------------------------------
+# the sampled-verify prover (temperature > 0)
+# ---------------------------------------------------------------------------
+
+# comparison primitives — deliberately OUTSIDE _ARITH (a compare is a
+# decision, not arithmetic, so normalized traces drop it), collected
+# explicitly here because the sampled acceptance test IS a float compare
+# whose dtype decides near-tie accept/reject flips
+_COMPARES = frozenset({"lt", "le", "gt", "ge"})
+
+
+def _rng_downstream_ids(graph: FlatGraph) -> tp.Set[int]:
+    """Value ids computed downstream of any PRNG draw (``random_bits``
+    outputs, forward consumer closure). In a sampled program this is
+    everything the drawn randomness can influence — the gumbel
+    arithmetic, the categorical argmax, and (in the verify program) the
+    acceptance compare and anything fed by an accepted token."""
+    seen: tp.Set[int] = set()
+    stack = [
+        oid for op in graph.ops if op.prim == "random_bits"
+        for oid in op.out_ids
+    ]
+    seen.update(stack)
+    while stack:
+        vid = stack.pop()
+        for op in graph.consumers.get(vid, []):
+            for oid in op.out_ids:
+                if oid not in seen:
+                    seen.add(oid)
+                    stack.append(oid)
+    return seen
+
+
+def _slice_records(ops: tp.Iterable[Op]) -> tp.Tuple[TraceRec, ...]:
+    """Sorted float-arithmetic records of an op slice — a multiset
+    fingerprint (program order varies legitimately between the decode
+    window's in-scan sampler and the verify program's row-0 sampler;
+    what must agree is which float ops run at which dtypes)."""
+    return tuple(sorted(
+        (
+            _dot_kind(op) if op.prim == "dot_general" else op.prim,
+            op.in_dtypes,
+            op.out_dtypes,
+        )
+        for op in ops
+        if op.prim in _ARITH and _is_float_op(op)
+    ))
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerChoreography:
+    """The sampled-path dtype choreography of one traced program: what
+    the temperature>0 prover compares between the decode window's
+    sampler and the verify program's rejection-sampling acceptance."""
+
+    name: str
+    # sorted float-arith records of the backward slice of each
+    # categorical argmax (jax lowers ``random.categorical`` to
+    # argmax(logits/T + gumbel), so this slice IS the sampler: the
+    # temperature division, the top-k mask arithmetic, the gumbel
+    # -log(-log u) chain) — all categoricals asserted identical
+    categorical: tp.Tuple[TraceRec, ...]
+    n_categoricals: int
+    # (prim, operand dtypes) of every float comparison downstream of the
+    # PRNG — in the verify program the rejection-sampling acceptance
+    # test ``u * q(t) <= p(t)`` lives here (the decode window has none:
+    # its sampler decides by argmax, not threshold)
+    rng_float_compares: tp.Tuple[tp.Tuple[str, tp.Tuple[str, ...]], ...]
+    # {sub, max, div, log} records of the residual-resample slice — the
+    # backward slice of the residual ``log`` (the one float log NOT in
+    # any categorical's gumbel chain): ``max(p - q, 0)`` and its
+    # renormalization (verify only; empty for the decode window)
+    residual: tp.Tuple[TraceRec, ...]
+    # the target-softmax ``exp`` inside the residual slice (the
+    # ``target_probs`` softmax the acceptance threshold and residual are
+    # computed from), None when absent
+    residual_exp: tp.Optional[TraceRec]
+
+
+def extract_sampler_choreography(
+    name: str, closed_jaxpr
+) -> SamplerChoreography:
+    """Normalize one SAMPLED (temperature > 0) traced program into its
+    comparable sampler choreography. Purely structural — no execution;
+    degenerate extractions (no categorical, no residual log) are
+    reported as empty fields and turned into failing checks by
+    :func:`prove_sampled_choreography`, never silently passed."""
+    graph = flatten_jaxpr(closed_jaxpr)
+    rng_ids = _rng_downstream_ids(graph)
+    argmaxes = [
+        op for op in graph.ops
+        if op.prim == "argmax"
+        and op.in_dtypes and op.in_dtypes[0] in _FLOAT_DTYPES
+        # the CATEGORICAL argmax consumes logits + gumbel noise; a
+        # greedy/verification argmax reads deterministic logits only
+        and any(i in rng_ids for i in op.in_ids if i >= 0)
+    ]
+    cat_op_idxs: tp.Set[int] = set()
+    cat_sigs: tp.List[tp.Tuple[TraceRec, ...]] = []
+    for am in argmaxes:
+        ops = _backward_ops(
+            graph, [i for i in am.in_ids if i >= 0]
+        )
+        cat_op_idxs.update(op.idx for op in ops)
+        cat_sigs.append(_slice_records(ops))
+    categorical: tp.Tuple[TraceRec, ...] = ()
+    if cat_sigs:
+        categorical = cat_sigs[0]
+        assert all(s == categorical for s in cat_sigs[1:]), (
+            f"{name}: categorical sampler slices disagree within one "
+            f"program"
+        )
+    compares = tuple(
+        (op.prim, op.in_dtypes)
+        for op in graph.ops
+        if op.prim in _COMPARES
+        and op.in_dtypes and op.in_dtypes[0] in _FLOAT_DTYPES
+        and any(i in rng_ids for i in op.in_ids if i >= 0)
+    )
+    # the residual-resample slice: every float log that is NOT gumbel
+    # arithmetic (gumbel logs live in a categorical's backward slice)
+    # roots the residual renormalization log(normalize(max(p - q, 0)))
+    resid_logs = [
+        op for op in graph.ops
+        if op.prim == "log" and op.out_dtypes[0] in _FLOAT_DTYPES
+        and op.idx not in cat_op_idxs
+    ]
+    resid_ops: tp.Dict[int, Op] = {}
+    for lg in resid_logs:
+        for op in _backward_ops(
+            graph, [i for i in lg.in_ids if i >= 0]
+        ):
+            resid_ops[op.idx] = op
+        resid_ops[lg.idx] = lg
+    residual = tuple(
+        rec for rec in _slice_records(resid_ops.values())
+        if rec[0] in ("sub", "max", "div", "log")
+    )
+    exps = [
+        op for op in resid_ops.values()
+        if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+    ]
+    residual_exp = (
+        ("exp", exps[0].in_dtypes, exps[0].out_dtypes) if exps else None
+    )
+    return SamplerChoreography(
+        name=name,
+        categorical=categorical,
+        n_categoricals=len(argmaxes),
+        rng_float_compares=compares,
+        residual=residual,
+        residual_exp=residual_exp,
+    )
+
+
+def prove_sampled_choreography(
+    decode: SamplerChoreography,
+    verify: SamplerChoreography,
+) -> tp.Tuple[ChoreoCheck, ...]:
+    """The four sampled-verify contracts, as checks to append to a
+    temperature>0 :class:`ChoreoReport`:
+
+    1. the verify program's row-0 categorical is the decode window's
+       sampler op for op (same tempered/top-k/gumbel dtype records) —
+       the sampled analogue of verify-mirrors-decode;
+    2. every float comparison the drawn randomness feeds — the
+       rejection-sampling acceptance test among them — runs in f32 (a
+       bf16 acceptance compare flips near-tie accept/reject decisions
+       exactly the way the PR 5 bf16 argmax flipped near-tie
+       acceptance);
+    3. the residual renormalization ``max(p - q, 0) / mass`` and its
+       log-encoding run in f32;
+    4. the target softmax feeding the acceptance threshold and the
+       residual runs in f32.
+
+    Degeneracy is failure: a sampled program in which the extractor
+    finds no categorical, no acceptance compare, or no residual slice
+    no longer has the shape the contract is about."""
+    checks: tp.List[ChoreoCheck] = []
+
+    ok1 = (
+        decode.n_categoricals >= 1
+        and verify.n_categoricals >= 1
+        and decode.categorical == verify.categorical
+    )
+    checks.append(ChoreoCheck(
+        name="sampled: verify row-0 sampler mirrors the decode window's "
+        "categorical",
+        ok=ok1,
+        detail="" if ok1 else (
+            f"decode categoricals={decode.n_categoricals} "
+            f"{decode.categorical} != verify "
+            f"categoricals={verify.n_categoricals} {verify.categorical}"
+        ),
+    ))
+
+    bad = [
+        (p, d) for (p, d) in verify.rng_float_compares
+        if set(d) != {"float32"}
+    ]
+    ok2 = bool(verify.rng_float_compares) and not bad
+    checks.append(ChoreoCheck(
+        name="sampled: acceptance compares run in f32",
+        ok=ok2,
+        detail="" if ok2 else (
+            f"non-f32 float compares downstream of the PRNG: {bad}"
+            if bad else "no float compare downstream of the PRNG — the "
+            "acceptance test is missing from the verify program"
+        ),
+    ))
+
+    bad_r = [r for r in verify.residual if set(r[1]) | set(r[2]) != {"float32"}]
+    ok3 = bool(verify.residual) and not bad_r
+    checks.append(ChoreoCheck(
+        name="sampled: residual renormalization runs in f32",
+        ok=ok3,
+        detail="" if ok3 else (
+            f"non-f32 residual records: {bad_r}" if bad_r
+            else "no residual-resample slice found in the verify program"
+        ),
+    ))
+
+    ok4 = (
+        verify.residual_exp is not None
+        and set(verify.residual_exp[1]) | set(verify.residual_exp[2])
+        == {"float32"}
+    )
+    checks.append(ChoreoCheck(
+        name="sampled: target softmax runs in f32 in the verify sampler",
+        ok=ok4,
+        detail="" if ok4 else (
+            f"target softmax exp: {verify.residual_exp}"
+        ),
+    ))
+    return tuple(checks)

@@ -671,13 +671,18 @@ def compile_verify_program(
     kv_quant: bool = False,
     layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
 ):
     """Compile the serving engine's speculative VERIFY program
     (``midgpt_tpu.serving.make_verify_program``) — the single dispatch
     that scores all slots' ``spec_len + 1`` candidate rows against the
-    resident pages, decides greedy acceptance, and folds only accepted
-    rows' K/V into the pool. Returns ``(hlo_text, mesh, donated_leaves,
-    audited_block_size)``.
+    resident pages, decides acceptance (greedy argmax at temperature 0,
+    rejection sampling above it — the sampled signature appends only the
+    per-slot request seeds and the base PRNG key, so the audited entry
+    traffic is the greedy program's plus two control-stream scalars
+    per slot), and folds only accepted rows' K/V into the pool. Returns
+    ``(hlo_text, mesh, donated_leaves, audited_block_size)``.
 
     Audited for the same serving invariants as the decode window and the
     prefill chunk: pool + logits donation intact (with speculation on,
@@ -700,15 +705,20 @@ def compile_verify_program(
     )
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
-        rope_len=model_cfg.block_size, mesh=prog_mesh,
-        layer_scan=layer_scan,
+        rope_len=model_cfg.block_size, temperature=temperature,
+        top_k=top_k, mesh=prog_mesh, layer_scan=layer_scan,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
-    hlo = verify_fn.lower(
+    lower_args = [
         model, pool, logits, i32(slots, pmax), i32(slots),
         np_.zeros((slots,), bool), i32(slots), i32(slots), i32(slots),
         i32(slots, spec_len), i32(slots),
-    ).compile().as_text()
+    ]
+    if temperature > 0.0:
+        lower_args += [
+            i32(slots), np_.zeros((2,), np_.uint32),
+        ]
+    hlo = verify_fn.lower(*lower_args).compile().as_text()
     donated_leaves = len(jax.tree.leaves((pool, logits)))
     payload = (
         serving_payload_shapes(
@@ -737,12 +747,17 @@ def audit_verify_program(
     layer_scan: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
 ):
     """One-call audit of the speculative verify program: donation-intact,
     no-host-sync, no-f64 (+ no-dequant-materialization when ``quant``)
     — the CI serving-audit job runs this next to
     :func:`audit_decode_window` and :func:`audit_prefill_chunk` so all
-    three serving hot-path programs are gated on one geometry."""
+    three serving hot-path programs are gated on one geometry.
+    ``temperature > 0`` audits the rejection-sampling verify program
+    against the SAME budgets: sampled acceptance must not cost a launch,
+    a host sync, or a traffic band."""
     cfg = (
         get_config(name_or_cfg)
         if isinstance(name_or_cfg, str)
@@ -753,6 +768,7 @@ def audit_verify_program(
             cfg, slots=slots, spec_len=spec_len, page_size=page_size,
             shrink=shrink, quant=quant, kv_quant=kv_quant,
             layer_scan=layer_scan, mesh_shape=mesh_shape,
+            temperature=temperature, top_k=top_k,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -781,6 +797,8 @@ def prove_serving_choreography(
     quant: bool = False,
     kv_quant: bool = False,
     paged_kernel: str = "xla",
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
 ):
     """Run the arithmetic-choreography prover
     (:mod:`midgpt_tpu.analysis.choreo`) over the three serving programs
@@ -806,15 +824,24 @@ ChoreoReport`.
     Pallas ragged-walk programs: the kernel appears as one contract
     node in the attention traces and its BODY's softmax signature is
     what the decode/verify checks then compare — a bf16-accumulating
-    kernel variant fails exactly like a bf16-accumulating XLA edit."""
+    kernel variant fails exactly like a bf16-accumulating XLA edit.
+    ``temperature > 0`` traces the SAMPLED programs instead and appends
+    the four sampled-verify checks
+    (:func:`~midgpt_tpu.analysis.choreo.prove_sampled_choreography`):
+    the verify row-0 categorical mirrors the decode window's sampler op
+    for op, the rejection-sampling acceptance compare runs in f32, and
+    the residual renormalization + target softmax run in f32."""
     import dataclasses as _dc
 
     import jax
     import jax.numpy as jnp
 
     from midgpt_tpu.analysis.choreo import (
+        ChoreoReport,
         extract_choreography,
+        extract_sampler_choreography,
         prove_choreography,
+        prove_sampled_choreography,
     )
     from midgpt_tpu.models.gpt import GPT
     from midgpt_tpu.ops.attention import naive_attention
@@ -841,6 +868,7 @@ ChoreoReport`.
         model, slots=slots, window=window, spec_len=spec_len,
         chunk_len=chunk_len, page_size=page_size,
         kv_quant="int8" if kv_quant else None, paged_kernel=paged_kernel,
+        temperature=temperature, top_k=top_k,
     )
 
     # the naive reference: what the monolithic prefill / training
@@ -862,13 +890,24 @@ ChoreoReport`.
     naive_jaxpr = jax.make_jaxpr(naive_ref)(
         jax.ShapeDtypeStruct((1, h + 2 * hkv, t, c), jnp.bfloat16)
     )
-    return prove_choreography(
+    report = prove_choreography(
         decode=extract_choreography("decode_window", jaxprs["decode_window"]),
         prefill=extract_choreography("prefill_chunk", jaxprs["prefill_chunk"]),
         verify=extract_choreography("verify", jaxprs["verify"]),
         naive=extract_choreography("naive_reference", naive_jaxpr),
         expect_kv_dequant=kv_quant,
     )
+    if temperature > 0.0:
+        sampled = prove_sampled_choreography(
+            extract_sampler_choreography(
+                "decode_window", jaxprs["decode_window"]
+            ),
+            extract_sampler_choreography("verify", jaxprs["verify"]),
+        )
+        report = ChoreoReport(
+            checks=report.checks + sampled, programs=report.programs
+        )
+    return report
 
 
 def prove_scan_equivalence(
@@ -941,6 +980,8 @@ def serving_dispatch_reports(
     spec_len: int = 4,
     chunk_len: int = 64,
     page_size: int = 16,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
 ) -> tp.Dict[str, tp.Any]:
     """Trace the three serving programs at the audit geometry (the same
     n_layer=2 shrink the byte budgets were measured at) and build their
@@ -949,7 +990,9 @@ def serving_dispatch_reports(
     ``prefill_chunk`` / ``verify_program``). Launch structure is
     precision-independent (quant/kv-quant change dtypes, not the scan
     nesting) — the flags exist so fault-injection tests can audit any
-    cell they traced."""
+    cell they traced. ``temperature > 0`` audits the SAMPLED programs
+    against the same cells: rejection-sampling acceptance is in-program
+    arithmetic and must not change the launch structure."""
     import dataclasses as _dc
 
     import jax
@@ -981,6 +1024,7 @@ def serving_dispatch_reports(
         chunk_len=chunk_len, page_size=page_size,
         kv_quant="int8" if kv_quant else None,
         paged_kernel=paged_kernel, layer_scan=layer_scan,
+        temperature=temperature, top_k=top_k,
     )
     return {
         "decode_window": dispatch_report(

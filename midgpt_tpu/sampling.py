@@ -55,15 +55,39 @@ def _pin_cache_layout(cache: KVCache) -> KVCache:
     )
 
 
+def _scaled_masked(
+    logits: Array, temperature: float, top_k: tp.Optional[int]
+) -> Array:
+    """Temperature-scale and top-k-mask ``logits`` — the pre-sampling
+    arithmetic SHARED by :func:`sample_token` (which feeds the result to
+    a key-derived categorical) and :func:`target_probs` (which softmaxes
+    it into the acceptance distribution of the sampled verify program).
+    One body on purpose: the choreo prover compares the two call sites
+    op for op, so the tempering/masking arithmetic must literally be the
+    same code, not two copies that could drift."""
+    logits = logits / temperature
+    if top_k is not None:
+        assert top_k > 0, f"top_k must be positive, got {top_k}"
+        top_k = min(top_k, logits.shape[-1])  # clamp to vocab
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
 def sample_token(
     logits: Array, key: Array, temperature: float, top_k: tp.Optional[int]
 ) -> Array:
     """One sampling decision: greedy argmax at ``temperature == 0``,
     temperature-scaled (optionally top-k-filtered) categorical otherwise.
     Shared by the fixed-batch sampler below and the serving engine's
-    decode window; the serving VERIFY program's acceptance check is the
-    ``temperature == 0`` branch of this function applied per candidate
-    row — which is why speculation is exactly greedy-equivalent.
+    decode window AND verify program: at ``temperature == 0`` the verify
+    program's acceptance check is this function's argmax branch applied
+    per candidate row (greedy speculation is exactly greedy-equivalent);
+    at ``temperature > 0`` the verify program's row-0 draw is this very
+    function under the same (seed, token-index) derived key, and its
+    rejection-sampling acceptance threshold is :func:`target_probs` —
+    the softmax of the SAME tempered/masked logits this function draws
+    from.
 
     Under a tensor-parallel serving mesh ``logits`` arrives
     VOCAB-SHARDED: the greedy branch partitions cleanly (per-shard
@@ -74,16 +98,105 @@ def sample_token(
     greedy-only (the sharded-serving audits gate the greedy programs)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None:
-        assert top_k > 0, f"top_k must be positive, got {top_k}"
-        top_k = min(top_k, logits.shape[-1])  # clamp to vocab
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, _scaled_masked(logits, temperature, top_k), axis=-1
+    ).astype(jnp.int32)
 
 
 _sample_token = sample_token  # back-compat alias (pre-PR 5 private name)
+
+
+def derive_request_key(key: Array, seed: Array, token_index: Array) -> Array:
+    """The per-request, per-position sampling key:
+    ``fold_in(fold_in(key, request_seed), token_index)``. This is the
+    serving determinism contract in one place — a request's stream
+    position ``i`` is always drawn with this key, whether the draw
+    happens in a decode window step, as the sampled verify program's
+    row 0, or as the residual resample after a rejected draft (the
+    verify program carries the residual as logits, so the NEXT
+    dispatch's row-0 draw at this very key IS the residual draw). Keys
+    are a function of (request seed, stream position) only — never slot
+    index, window size, batch composition, or chunking — which is what
+    makes sampled streams bitwise scheduling-invariant."""
+    return jax.random.fold_in(jax.random.fold_in(key, seed), token_index)
+
+
+# Salt folded into a position's derived key to produce the ACCEPTANCE
+# uniform for that position (speculative rejection sampling). A salted
+# substream, not the categorical stream itself: position i's categorical
+# key must stay untouched so a rejection at i resamples with exactly the
+# key the non-speculative engine would have used there.
+SPEC_ACCEPT_SALT = 0x5BEC
+
+
+def target_probs(
+    logits: Array, temperature: float, top_k: tp.Optional[int]
+) -> Array:
+    """The model's sampling distribution as probabilities, in f32:
+    ``softmax(_scaled_masked(logits))``. This is BY CONSTRUCTION the
+    distribution :func:`sample_token` draws from at the same
+    ``(temperature, top_k)`` — the verify program's acceptance test
+    ``u * q(t) <= p(t)`` and residual ``max(p - q, 0)`` use it, so
+    accepted drafts are distributed exactly like decode-window draws
+    (standard speculative-sampling exactness). f32 throughout: the
+    acceptance compare is the new near-tie surface (the same bug class
+    the PR 4/5 dtype drifts hit), and the choreo prover pins it."""
+    return jax.nn.softmax(
+        _scaled_masked(logits.astype(jnp.float32), temperature, top_k),
+        axis=-1,
+    )
+
+
+def acceptance_mask(u: Array, q_sel: Array, p_sel: Array) -> Array:
+    """Rejection-sampling acceptance: accept a drafted token ``t`` iff
+    ``u * q(t) <= p(t)`` — the multiplied form of ``u <= p(t)/q(t)``
+    (no division, so a zero draft probability cannot produce inf/nan;
+    ``q(t) = 0`` accepts always, which is the correct limit: the draft
+    distribution then carries no mass to reject against). For one-hot
+    n-gram drafts ``q(t) = 1`` and this degenerates to ``u <= p(t)``.
+
+    A named module-level seam on purpose: the acceptance compare is
+    where a dtype drift would silently skew the sampled distribution
+    (bf16 rounds p near ulp boundaries), so the choreo prover proves its
+    operands are f32 and the fault-injection test monkeypatches THIS
+    function with a drifted-dtype variant to prove exactly that clause
+    fails."""
+    return (u * q_sel) <= p_sel
+
+
+def residual_logits(
+    p: Array, q: Array, temperature: float
+) -> tp.Tuple[Array, Array]:
+    """Logits whose :func:`sample_token` draw IS the rejection-sampling
+    residual draw: ``temperature * log(normalize(max(p - q, 0)))``, plus
+    the residual mass ``sum(max(p - q, 0))`` (callers fall back to the
+    raw logits row when the mass is 0 — a float-exactness corner where
+    ``p <= q`` everywhere, meaning the acceptance test could not have
+    rejected except at an exact boundary).
+
+    Why this shape: the verify program does not draw the resample token
+    in-dispatch (the rejected row's K/V was computed for the DRAFT
+    token, so an in-dispatch resample would need pending-token replumb
+    of the pool write path). Instead it CARRIES these logits out, and
+    the next dispatch's ordinary row-0 ``sample_token`` at the position's
+    derived key performs the draw: the temperature division cancels the
+    ``temperature *`` here, top-k masking is a no-op on a <= top_k
+    support vector (the kth-largest of a shorter-support row sorts to
+    -inf, and nothing compares below -inf), and the categorical's
+    gumbel-argmax is shift-invariant — so the draw is exactly
+    ``categorical(residual)`` with zero special cases in the sampler.
+    (Exact float ties inside ``_scaled_masked``'s kth threshold can
+    widen p's support past top_k; the carried draw then re-applies
+    top-k on the residual — a measure-zero corner that keeps streams
+    deterministic either way.)"""
+    resid = jnp.maximum(p - q, 0.0)
+    mass = jnp.sum(resid, axis=-1)
+    denom = jnp.where(mass > 0.0, mass, 1.0)[..., None]
+    norm = jnp.where(resid > 0.0, resid / denom, 1.0)
+    out = jnp.where(
+        resid > 0.0, temperature * jnp.log(norm), -jnp.inf
+    )
+    return out, mass
 
 
 def generate(

@@ -185,9 +185,13 @@ def generate_served(
     every prompt, drain, return the generated token arrays in submission
     order. The engine path to the fixed-batch ``sampling.generate`` —
     same greedy tokens, 1/K the decode dispatches, and per-request early
-    exit at ``eos_id``. ``speculate=N`` (greedy only) turns decode
-    dispatches into n-gram-drafted verify dispatches emitting
-    ``1 + accepted`` tokens each — same tokens, fewer launches.
+    exit at ``eos_id``. ``speculate=N`` turns decode dispatches into
+    n-gram-drafted verify dispatches emitting ``1 + accepted`` tokens
+    each — at ``temperature == 0`` acceptance is argmax agreement (same
+    tokens, fewer launches); at ``temperature > 0`` it is rejection
+    sampling against the decode sampler's own distribution (same token
+    DISTRIBUTION and the same per-request key-derivation determinism,
+    fewer launches).
     ``quant="int8"`` serves the int8 per-channel quantized weight path
     (midgpt_tpu.quant: dequant fused into each matmul — halves the
     per-token weight stream; po2 scales keep greedy output token-

@@ -33,13 +33,20 @@ PERF.md r5) once per generated token. This engine replaces both:
   candidate rows in one joint-softmax multi-query pass whose arithmetic
   mirrors the decode window's op for op (gpt.verify_paged_at — bf16
   near-ties flip under any other dtype choreography).
-  Greedy acceptance is longest-prefix argmax agreement; each dispatch
-  emits 1 + accepted tokens (the "+1" is the previous dispatch's bonus
-  token, materialized from the carried logits). Rejected rows roll back
-  via a per-slot write watermark: their K/V never lands in the pages,
-  so the single-writer / refcount / prefix-index invariants are
-  untouched. Greedy outputs are token-identical to the non-speculative
-  engine — speculation changes the dispatch count, not the stream.
+  Acceptance is longest-prefix: argmax agreement at ``temperature ==
+  0``, REJECTION SAMPLING at ``temperature > 0`` (accept draft t with
+  probability ``min(1, p_target(t)/q_draft(t))`` against the decode
+  sampler's own tempered/top-k distribution; on rejection the carried
+  logits encode the normalized residual ``max(p - q, 0)`` so the next
+  dispatch's row-0 draw IS the resample — see _build_verify_program).
+  Each dispatch emits 1 + accepted tokens (the "+1" is the previous
+  dispatch's bonus token, materialized from the carried logits).
+  Rejected rows roll back via a per-slot write watermark: their K/V
+  never lands in the pages, so the single-writer / refcount /
+  prefix-index invariants are untouched. Greedy outputs are
+  token-identical to the non-speculative engine, sampled outputs are
+  distributed exactly as it and keep its bitwise scheduling invariance
+  — speculation changes the dispatch count, not the stream contract.
 - **Int8 quantized weight path** (``quant="int8"``, midgpt_tpu.quant):
   every program the engine compiles streams int8 per-output-channel
   weights with the dequantization fused into each matmul's epilogue —
@@ -65,10 +72,14 @@ PERF.md r5) once per generated token. This engine replaces both:
   scan-equivalence prover + the analysis.dispatch launch budgets.
 
 Determinism contract: per-request sampling keys derive from
-``fold_in(fold_in(key, request_seed), tokens_emitted_so_far)`` — the token
-stream of a request is a function of the request alone, independent of
-which slot it lands in, the window size K, batch composition, any
-mid-run eviction/re-admission, prefix-cache hits, and prefill chunking.
+``fold_in(fold_in(key, request_seed), tokens_emitted_so_far)``
+(sampling.derive_request_key) — the token stream of a request is a
+function of the request alone, independent of which slot it lands in,
+the window size K, batch composition, any mid-run eviction/re-admission,
+prefix-cache hits, and prefill chunking. Speculation at temperature > 0
+keeps the contract: its acceptance uniforms come from a SALTED substream
+of the same per-position derived key (sampling.SPEC_ACCEPT_SALT), so
+they too are functions of (request seed, stream position) only.
 """
 
 from __future__ import annotations
@@ -238,7 +249,7 @@ def _build_decode_window(
     prefill and decode interleave without a second program shape.
     """
     from midgpt_tpu.parallel.sharding import axis_rules, shard_act
-    from midgpt_tpu.sampling import sample_token
+    from midgpt_tpu.sampling import derive_request_key, sample_token
 
     rshape = (cfg.n_layer, slots, cfg.kv_heads, window, cfg.head_dim)
 
@@ -276,9 +287,7 @@ def _build_decode_window(
                 # per-request key stream: (seed, emitted-count) — slot-,
                 # window-, and eviction-invariant
                 ks = jax.vmap(
-                    lambda sd, ti: jax.random.fold_in(
-                        jax.random.fold_in(key, sd), ti
-                    )
+                    lambda sd, ti: derive_request_key(key, sd, ti)
                 )(seeds, em)
                 return jax.vmap(
                     lambda l1, k1: sample_token(
@@ -421,19 +430,31 @@ def make_verify_program(
     pmax: int,
     rope_len: int,
     pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
+    soft_drafts: bool = False,
     mesh=None,
     paged_kernel: str = "xla",
     layer_scan: str = "off",
 ):
+    # temperature == 0.0 builds the exact greedy program (same signature,
+    # same arithmetic — no seeds/key entry args); sampling params join
+    # the cache key only as the knobs they are. soft_drafts (a proposer
+    # that supplies a dense draft distribution — the injectable test
+    # path) is a distinct program SHAPE: it adds a [S, spec_len, V]
+    # entry tensor the default one-hot path deliberately never
+    # materializes (see _build_verify_program).
     key = (
         "verify", model.config, slots, spec_len, pmax, rope_len, pad_id,
-        paged_kernel, layer_scan, _mesh_key(mesh),
+        temperature, top_k, soft_drafts, paged_kernel, layer_scan,
+        _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_verify_program(
             model.config, slots=slots, spec_len=spec_len, pmax=pmax,
-            rope_len=rope_len, pad_id=pad_id, mesh=mesh,
+            rope_len=rope_len, pad_id=pad_id, temperature=temperature,
+            top_k=top_k, soft_drafts=soft_drafts, mesh=mesh,
             paged_kernel=paged_kernel, layer_scan=layer_scan,
         ),
     )
@@ -450,41 +471,88 @@ def _build_verify_program(
     mesh,
     paged_kernel: str = "xla",
     layer_scan: str = "off",
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
+    soft_drafts: bool = False,
 ):
     """The speculative-decoding verification program: ONE jitted,
     pool/logits-donating dispatch that scores every slot's
-    ``[T = spec_len + 1]`` candidate rows (the true next token, argmaxed
-    in-program from the carried logits, followed by the host's drafts)
-    against the resident paged KV via ``models.gpt.verify_tokens_paged``,
-    computes greedy longest-prefix acceptance, EOS/budget truncation, and
-    the per-slot WRITE WATERMARK, and folds only the accepted rows' K/V
-    into the pages (one bulk scatter — rejected rows route to the drop
-    sentinel, which IS the rollback: stale speculation never becomes
-    visible to the pool, the prefix index, or another block table).
+    ``[T = spec_len + 1]`` candidate rows (the true next token,
+    materialized in-program from the carried logits, followed by the
+    host's drafts) against the resident paged KV via
+    ``models.gpt.verify_tokens_paged``, computes longest-prefix
+    acceptance, EOS/budget truncation, and the per-slot WRITE WATERMARK,
+    and folds only the accepted rows' K/V into the pages (one bulk
+    scatter — rejected rows route to the drop sentinel, which IS the
+    rollback: stale speculation never becomes visible to the pool, the
+    prefix index, or another block table).
 
-    Per dispatch each live slot emits ``1 + accepted`` tokens: row 0 is
-    exact by construction (it is what the non-speculative window's first
-    step would have sampled from the same carried logits), and draft row
-    j is accepted iff it equals the argmax after row j-1 — which, chained
-    from row 0, is exactly the token the plain engine would have produced
-    there. The carried logits row advances to the last EMITTED row's
-    logits, so the next dispatch's row 0 is this dispatch's bonus token
-    (the model's own continuation at the first mismatch). Greedy only —
-    the engine asserts ``temperature == 0`` when speculation is on.
+    At ``temperature == 0`` acceptance is greedy argmax agreement: draft
+    row j is accepted iff it equals the argmax after row j-1 — chained
+    from row 0, exactly the token the plain engine would have produced
+    there, so greedy speculation is token-identical to the plain window.
+
+    At ``temperature > 0`` acceptance is REJECTION SAMPLING, still in
+    the same single dispatch: row 0 is drawn by the very
+    ``sampling.sample_token`` the decode window uses, under the same
+    per-request ``derive_request_key(key, seed, emitted)`` — so sampled
+    row 0 is bitwise what the plain window's first step would have
+    drawn. Draft row j (token t, draft probability q(t)) is accepted iff
+    ``u_j * q(t) <= p(t)`` where ``p = target_probs(logits after row
+    j-1)`` is the decode sampler's own distribution (softmax of the
+    SAME tempered/top-k-masked logits ``sample_token`` draws from) and
+    ``u_j`` is a uniform keyed by a SALTED substream of the position's
+    derived key — a function of (request seed, stream position) only,
+    never slot/window/batch, so sampled streams keep the greedy path's
+    bitwise scheduling invariance. n-gram drafts carry one-hot draft
+    probabilities (``q(t) = 1``, built in-program — no dense tensor
+    crosses the dispatch boundary; see serving.speculate), collapsing
+    the test to ``u <= p(t)``; a ``soft_drafts`` proposer ships a dense
+    ``[S, spec_len, V]`` distribution instead (the injectable test path
+    that exercises the general acceptance ratio).
+
+    On rejection the program does NOT emit a resample token in-dispatch
+    (the rejected row's K/V encodes the DRAFT token — emitting anything
+    else would corrupt the pool). Instead the carried logits become
+    ``temperature * log(normalize(max(p - q, 0)))`` — the residual
+    distribution, encoded so the NEXT dispatch's ordinary row-0
+    ``sample_token`` at that position's derived key IS the residual
+    draw (``sampling.residual_logits`` documents the exactness
+    argument). On full acceptance (or EOS/budget truncation) the carry
+    is the last emitted row's raw logits, as in the greedy program —
+    the next row-0 draw is then the standard speculative-sampling bonus
+    token from the full target distribution. Either way every dispatch
+    emits ``1 + accepted`` tokens and the stream is distributed exactly
+    as the non-speculative sampled engine (classic speculative-sampling
+    exactness, statistically tested in tests/test_serving.py; the
+    acceptance/residual dtype choreography is proven by
+    analysis.choreo's sampled-verify checks).
 
     Slot semantics mirror :func:`make_decode_window` exactly: done/empty
     slots ride along masked (pad candidates, no emissions, no writes),
     budget counts emitted tokens, an emitted EOS is kept and everything
     after it dropped, and a terminal token's K/V row is not written (no
     real token can follow it)."""
+    from midgpt_tpu import sampling as sampling_mod
     from midgpt_tpu.parallel.sharding import axis_rules, shard_act
+    from midgpt_tpu.sampling import (
+        SPEC_ACCEPT_SALT,
+        derive_request_key,
+        residual_logits,
+        sample_token,
+        target_probs,
+    )
 
     assert spec_len >= 1, spec_len
+    assert not (soft_drafts and temperature == 0.0), (
+        "soft_drafts is a sampled-verify program shape; greedy "
+        "acceptance never reads draft probabilities"
+    )
     t = spec_len + 1
 
-    def verify_fn(
-        model: GPT,  # entry parameter (same constant-folding trap as
-        # the decode window — see make_decode_window)
+    def _verify_core(
+        model: GPT,  # ENTRY PARAMETER (constant-folding trap, see
+        # make_decode_window)
         pool: PagedKVPool,  # DONATED
         logits: Array,  # [S, V] f32 — per-slot next-token logits; DONATED
         bt: Array,  # [S, Pmax] int32 block tables
@@ -495,14 +563,30 @@ def _build_verify_program(
         eos: Array,  # [S] int32 — per-request EOS id (-1 = none)
         drafts: Array,  # [S, spec_len] int32 — host n-gram drafts
         n_draft: Array,  # [S] int32 in [0, spec_len] — per-slot draft len
+        seeds: tp.Optional[Array] = None,  # [S] int32 (sampled only)
+        key: tp.Optional[Array] = None,  # base PRNG key (sampled only)
+        draft_probs: tp.Optional[Array] = None,  # [S, spec_len, V]
+        # (soft_drafts only) — the dense draft distribution
     ):
         assert bt.shape == (slots, pmax), (
             f"block table {bt.shape} != declared geometry ({slots}, {pmax})"
         )
         with axis_rules(mesh, serving_logical_rules()):
             # row 0: the true next token, materialized from the carried
-            # logits (greedy — the same argmax the window's step 0 takes)
-            t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # logits — the same decision the plain window's step 0 takes
+            # from the same logits (argmax at T=0, sample_token under
+            # the position's derived key at T>0)
+            if temperature == 0.0:
+                t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                ks0 = jax.vmap(
+                    lambda sd, ti: derive_request_key(key, sd, ti)
+                )(seeds, emitted)
+                t0 = jax.vmap(
+                    lambda l1, k1: sample_token(
+                        l1[None], k1, temperature, top_k
+                    )[0]
+                )(logits, ks0)
             t0 = jnp.where(done, jnp.int32(pad_id), t0)
             cand = jnp.concatenate([t0[:, None], drafts], axis=1)  # [S, T]
             all_logits, ks, vs = verify_tokens_paged(
@@ -510,13 +594,65 @@ def _build_verify_program(
                 pool_sk=pool.scale_k, pool_sv=pool.scale_v,
                 paged_kernel=paged_kernel, layer_scan=layer_scan,
             )  # all_logits: [S, T, V]; ks/vs: [L, S, Hkv, T, C]
-            preds = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
-            # draft row j (cand[:, j], j >= 1) matches iff it equals the
-            # model's argmax after row j-1 and sits within the slot's
-            # draft length; acceptance is the longest matching PREFIX
-            match = (cand[:, 1:] == preds[:, :-1]) & (
-                jnp.arange(spec_len)[None, :] < n_draft[:, None]
-            )
+            if temperature == 0.0:
+                preds = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+                # draft row j (cand[:, j], j >= 1) matches iff it equals
+                # the model's argmax after row j-1 and sits within the
+                # slot's draft length; acceptance is the longest
+                # matching PREFIX
+                match = (cand[:, 1:] == preds[:, :-1]) & (
+                    jnp.arange(spec_len)[None, :] < n_draft[:, None]
+                )
+                p = qf = None
+            else:
+                # the target distribution after each prefix row — BY
+                # CONSTRUCTION what sample_token draws from at this
+                # (temperature, top_k); f32 throughout (the acceptance
+                # compare is the sampled path's near-tie surface, pinned
+                # by the choreo prover)
+                p = target_probs(
+                    all_logits[:, :-1], temperature, top_k
+                )  # [S, spec_len, V] f32
+                p_sel = jnp.take_along_axis(
+                    p, cand[:, 1:, None], axis=2
+                )[..., 0]  # [S, spec_len] — p(draft token)
+                if soft_drafts:
+                    qf = draft_probs.astype(jnp.float32)
+                    q_sel = jnp.take_along_axis(
+                        qf, cand[:, 1:, None], axis=2
+                    )[..., 0]
+                else:
+                    # one-hot n-gram drafts: q(draft token) = 1 — built
+                    # in-program so no [S, spec_len, V] tensor crosses
+                    # the dispatch boundary (the verify program's
+                    # traffic budget cells stay exactly as greedy)
+                    qf = None
+                    q_sel = jnp.ones((slots, spec_len), jnp.float32)
+                # acceptance uniforms: one per (request, stream
+                # position), keyed by a salted substream of the
+                # position's derived key — independent of the
+                # categorical stream (a rejection at position i must
+                # resample with position i's untouched categorical key)
+                # and invariant to slot/window/batch/eviction
+                pos = emitted[:, None] + jnp.arange(
+                    1, spec_len + 1, dtype=jnp.int32
+                )[None, :]
+                u = jax.vmap(
+                    jax.vmap(
+                        lambda sd, ti: jax.random.uniform(
+                            jax.random.fold_in(
+                                derive_request_key(key, sd, ti),
+                                SPEC_ACCEPT_SALT,
+                            ),
+                            (),
+                            jnp.float32,
+                        ),
+                        in_axes=(None, 0),
+                    )
+                )(seeds, pos)  # [S, spec_len] f32
+                match = sampling_mod.acceptance_mask(u, q_sel, p_sel) & (
+                    jnp.arange(spec_len)[None, :] < n_draft[:, None]
+                )
             acc = jnp.cumprod(match.astype(jnp.int32), axis=1) > 0
             ok = jnp.concatenate(
                 [jnp.ones((slots, 1), bool), acc], axis=1
@@ -548,21 +684,100 @@ def _build_verify_program(
             # scratch until an admission overwrites the row. f32 widening
             # is exact, same as the decode window's carry.
             last = jnp.clip(n_emit - 1, 0, t - 1)
-            new_logits = jnp.take_along_axis(
+            base = jnp.take_along_axis(
                 all_logits, last[:, None, None], axis=1
-            )[:, 0].astype(logits.dtype)
-            # the take_along_axis indexes the (replicated) row dim of a
-            # vocab-sharded [S, T, V]; pin the carry so the donated
-            # logits buffer keeps its committed sharding
-            new_logits = shard_act(new_logits, None, "vocab")
+            )[:, 0]
             # accepted = drafts the MODEL agreed with (pre-EOS/budget
             # truncation): the honest acceptance signal for adaptation —
             # end-of-generation budget clipping is not a drafting miss
             n_acc = jnp.sum(acc.astype(jnp.int32), axis=1)
+            if temperature == 0.0:
+                new_logits = base.astype(logits.dtype)
+            else:
+                # rejection carry: when the emission prefix stopped at a
+                # REJECTED draft (not EOS/budget truncation), the next
+                # row-0 draw must come from the residual distribution
+                # max(p - q, 0) at the rejected position — encoded as
+                # logits so the next dispatch's ordinary sample_token at
+                # that position's derived key IS the residual draw (see
+                # sampling.residual_logits for the exactness argument).
+                rej = jnp.clip(n_acc, 0, spec_len - 1)  # first rejected row
+                p_carry = jnp.take_along_axis(
+                    p, rej[:, None, None], axis=1
+                )[:, 0]  # [S, V] — target probs at the rejected position
+                if soft_drafts:
+                    q_carry = jnp.take_along_axis(
+                        qf, rej[:, None, None], axis=1
+                    )[:, 0]
+                else:
+                    d_rej = jnp.take_along_axis(
+                        drafts, rej[:, None], axis=1
+                    )[:, 0]
+                    q_carry = jax.nn.one_hot(
+                        d_rej, cfg.vocab_size, dtype=jnp.float32
+                    )
+                resid_lg, mass = residual_logits(
+                    p_carry, q_carry, temperature
+                )
+                # residual only when the prefix genuinely ended at a
+                # rejection: some draft was rejected (n_acc < n_draft)
+                # AND no EOS/budget clip shortened the prefix first
+                # (n_emit == 1 + n_acc) AND the residual has mass (a
+                # one-hot q fully inside p's top-k support can zero it —
+                # then p == q at that token was impossible to reject,
+                # but guard anyway and fall back to the full target)
+                use_resid = (
+                    (n_acc < n_draft)
+                    & (n_emit == n_acc + 1)
+                    & (mass > 0.0)
+                )
+                new_logits = jnp.where(
+                    use_resid[:, None], resid_lg,
+                    base.astype(jnp.float32),
+                ).astype(logits.dtype)
+            # the take_along_axis indexes the (replicated) row dim of a
+            # vocab-sharded [S, T, V]; pin the carry so the donated
+            # logits buffer keeps its committed sharding
+            new_logits = shard_act(new_logits, None, "vocab")
         return (
             pool, new_logits, cand, emit, new_done, new_len, new_emitted,
             n_acc,
         )
+
+    # the greedy wrapper keeps the pre-sampled 11-arg signature (and
+    # arithmetic) byte-for-byte: existing greedy budgets, audits, and
+    # bitwise stream tests see the exact same program. The sampled
+    # shapes append only [S] seeds + the base key (control-stream
+    # traffic) — and, for the soft-draft test variant, the dense draft
+    # distribution.
+    if temperature == 0.0:
+        def verify_fn(
+            model, pool, logits, bt, pooled_len, done, emitted, budget,
+            eos, drafts, n_draft,
+        ):
+            return _verify_core(
+                model, pool, logits, bt, pooled_len, done, emitted,
+                budget, eos, drafts, n_draft,
+            )
+    elif not soft_drafts:
+        def verify_fn(
+            model, pool, logits, bt, pooled_len, done, emitted, budget,
+            eos, drafts, n_draft, seeds, key,
+        ):
+            return _verify_core(
+                model, pool, logits, bt, pooled_len, done, emitted,
+                budget, eos, drafts, n_draft, seeds=seeds, key=key,
+            )
+    else:
+        def verify_fn(
+            model, pool, logits, bt, pooled_len, done, emitted, budget,
+            eos, drafts, n_draft, seeds, key, draft_probs,
+        ):
+            return _verify_core(
+                model, pool, logits, bt, pooled_len, done, emitted,
+                budget, eos, drafts, n_draft, seeds=seeds, key=key,
+                draft_probs=draft_probs,
+            )
 
     return jax.jit(verify_fn, donate_argnums=(1, 2))
 
@@ -580,11 +795,16 @@ def trace_serving_programs(
     kv_quant: tp.Optional[str] = None,
     paged_kernel: str = "xla",
     layer_scan: str = "off",
+    temperature: float = 0.0,
+    top_k: tp.Optional[int] = None,
 ) -> tp.Dict[str, tp.Any]:
     """Abstractly trace the engine's three hot-path programs to jaxprs —
     the input of the arithmetic-choreography prover
     (:mod:`midgpt_tpu.analysis.choreo`). Returns
     ``{"decode_window": ClosedJaxpr, "prefill_chunk": ..., "verify": ...}``.
+    ``temperature > 0`` traces the SAMPLED decode window and the
+    rejection-sampling verify program (its signature grows the per-slot
+    seeds + base key the sampled acceptance derives its streams from).
 
     Tracing goes through the very same jitted callables the engine
     launches (:func:`make_decode_window` et al.), so the prover sees the
@@ -610,8 +830,8 @@ def trace_serving_programs(
 
     window_fn = make_decode_window(
         model, slots=slots, window=window, pmax=pmax,
-        rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
-        layer_scan=layer_scan,
+        rope_len=cfg.block_size, temperature=temperature, top_k=top_k,
+        mesh=mesh, paged_kernel=paged_kernel, layer_scan=layer_scan,
     )
     decode_jaxpr = jax.make_jaxpr(window_fn)(
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
@@ -628,14 +848,17 @@ def trace_serving_programs(
     )
     verify_fn = make_verify_program(
         model, slots=slots, spec_len=spec_len, pmax=pmax,
-        rope_len=cfg.block_size, mesh=mesh, paged_kernel=paged_kernel,
-        layer_scan=layer_scan,
+        rope_len=cfg.block_size, temperature=temperature, top_k=top_k,
+        mesh=mesh, paged_kernel=paged_kernel, layer_scan=layer_scan,
     )
-    verify_jaxpr = jax.make_jaxpr(verify_fn)(
+    verify_args = [
         model, pool, logits, i32(slots, pmax), i32(slots), pred(slots),
         i32(slots), i32(slots), i32(slots), i32(slots, spec_len),
         i32(slots),
-    )
+    ]
+    if temperature > 0.0:
+        verify_args += [i32(slots), sds((2,), jnp.uint32)]
+    verify_jaxpr = jax.make_jaxpr(verify_fn)(*verify_args)
     return {
         "decode_window": decode_jaxpr,
         "prefill_chunk": chunk_jaxpr,
@@ -1041,21 +1264,37 @@ class ServingEngine:
             if prefill_budget is not None
             else prefill_chunk  # None (monolithic) -> unlimited
         )
+        # sampling config: temperature == 0 is greedy, temperature > 0
+        # samples — in BOTH the plain window and the speculative verify
+        # program (rejection-sampling acceptance; see
+        # _build_verify_program). A negative temperature is the only
+        # genuinely unsupported sampling config: typed error, not assert
+        # (callers surface it as a config problem, not a library bug).
+        if temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}"
+            )
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be None or >= 1, got {top_k}")
+        self.temperature = float(temperature)
+        self.top_k = top_k
         assert speculate >= 0, speculate
         if speculate:
-            # acceptance is argmax agreement — exact for greedy, with no
-            # exact analogue under temperature sampling (a rejection-
-            # sampling scheme would change the carried-key discipline)
-            assert temperature == 0.0, (
-                "speculative decoding (speculate > 0) is greedy-only; "
-                "set temperature=0.0 or speculate=0"
-            )
             assert speculate < self.block, speculate
         self.speculate = int(speculate)
         self.proposer: tp.Optional[Proposer] = (
             proposer
             if proposer is not None
             else (NgramProposer() if speculate else None)
+        )
+        # a soft proposer (SoftProposer protocol: soft=True +
+        # propose_soft) ships a dense [S, spec_len, V] draft
+        # distribution into the verify dispatch; n-gram drafts are
+        # one-hot and never materialize it (see serving.speculate)
+        self._soft_drafts = bool(
+            self.speculate
+            and temperature > 0.0
+            and getattr(self.proposer, "soft", False)
         )
         # tokens a decode dispatch may write per slot: K for the plain
         # window, spec_len + 1 candidate rows for the verify program —
@@ -1140,6 +1379,9 @@ class ServingEngine:
                 pmax=self.pmax,
                 rope_len=self.block,
                 pad_id=pad_id,
+                temperature=temperature,
+                top_k=top_k,
+                soft_drafts=self._soft_drafts,
                 mesh=mesh,
                 paged_kernel=self.paged_kernel,
                 layer_scan=self.layer_scan,
@@ -1907,15 +2149,30 @@ class ServingEngine:
 
     def _draft(
         self, decoding: tp.List[int]
-    ) -> tp.Tuple[np.ndarray, np.ndarray]:
+    ) -> tp.Tuple[np.ndarray, np.ndarray, tp.Optional[np.ndarray]]:
         """Host-side n-gram drafts for this verify dispatch: up to
         ``req.spec_k`` (the slot's ADAPTIVE draft length) guesses for the
         tokens FOLLOWING the pending next token, suffix-matched from the
         request's own prompt+generated history. Slots with no usable
         match ride with ``n_draft = 0`` — the dispatch degrades to plain
-        one-token decode for them, never stalls them."""
+        one-token decode for them, never stalls them.
+
+        Returns ``(drafts, n_draft, draft_probs)``; ``draft_probs`` is
+        the dense ``[S, spec_len, V]`` draft distribution when the
+        proposer is soft (SoftProposer protocol), else ``None`` — the
+        default n-gram path is one-hot IN-PROGRAM and never builds it
+        (zero rows are safe: every row past ``n_draft`` is masked out of
+        acceptance and the residual carry)."""
         drafts = np.zeros((self.slots, self.speculate), np.int32)
         n_draft = np.zeros((self.slots,), np.int32)
+        probs = (
+            np.zeros(
+                (self.slots, self.speculate, self.model.config.vocab_size),
+                np.float32,
+            )
+            if self._soft_drafts
+            else None
+        )
         for s in decoding:
             req = self.slot_req[s]
             # clamp to the remaining budget: row 0 takes one of the
@@ -1926,11 +2183,25 @@ class ServingEngine:
             k = min(req.spec_k, self.speculate, remaining - 1)
             if k < 1:
                 continue
-            got = self.proposer.propose(self.slot_ctx[s], k)
-            got = got[: self.speculate]
+            if probs is not None:
+                # the request seed rides along: honest soft drafting
+                # needs per-request entropy (see SoftProposer — a
+                # ctx-only-derandomized "sample" is a point mass and
+                # breaks rejection-sampling exactness across requests)
+                got, q = self.proposer.propose_soft(
+                    self.slot_ctx[s], k, req.seed
+                )
+                got = list(got)[: self.speculate]
+                if len(got):
+                    probs[s, : len(got)] = np.asarray(
+                        q, np.float32
+                    )[: len(got)]
+            else:
+                got = self.proposer.propose(self.slot_ctx[s], k)
+                got = got[: self.speculate]
             drafts[s, : len(got)] = got
             n_draft[s] = len(got)
-        return drafts, n_draft
+        return drafts, n_draft, probs
 
     def _adapt_spec(self, req: Request, drafted: int, accepted: int) -> None:
         """Per-request draft-length controller: track a trailing
@@ -1957,15 +2228,12 @@ class ServingEngine:
     def _run_verify(self, decoding: tp.List[int]) -> None:
         """One speculative verify dispatch + harvest (the spec-mode
         replacement for the K-step decode window)."""
-        drafts, n_draft = self._draft(decoding)
+        drafts, n_draft, draft_probs = self._draft(decoding)
         tele = self.telemetry
         if tele is not None:
             t0 = self.clock()
             rids = tuple(self.slot_req[s].rid for s in decoding)
-        (
-            self.pool, self.logits, cand, emit, done_d, new_len,
-            emitted_d, n_acc,
-        ) = self._verify_fn(
+        args = [
             self.model,
             self.pool,
             self.logits,
@@ -1977,7 +2245,20 @@ class ServingEngine:
             jnp.asarray(self.eos),
             jnp.asarray(drafts),
             jnp.asarray(n_draft),
-        )
+        ]
+        if self.temperature > 0.0:
+            # sampled verify: per-slot request seeds + the engine's base
+            # key — the program derives every categorical/acceptance
+            # stream from (seed, stream position) alone, so the same
+            # discipline that makes the plain sampled window scheduling-
+            # invariant carries over to speculation unchanged
+            args += [jnp.asarray(self.seeds), self._key]
+            if draft_probs is not None:
+                args.append(jnp.asarray(draft_probs))
+        (
+            self.pool, self.logits, cand, emit, done_d, new_len,
+            emitted_d, n_acc,
+        ) = self._verify_fn(*args)
         self.decode_dispatches += 1
         self.verify_dispatches += 1
         self.windows += 1

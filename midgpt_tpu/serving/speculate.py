@@ -20,11 +20,28 @@ way the proposal is free of model FLOPs and composes with every config —
 there is no second model to shard, checkpoint, or keep in HBM.
 
 Determinism: proposals are a pure function of the context token list, so
-the engine's greedy output is token-identical to the non-speculative
-path regardless of what is proposed — acceptance verifies against the
-model's own argmax before anything is emitted. A bad proposer costs
-throughput, never correctness (property-tested with an adversarial
-proposer in tests/test_serving.py).
+the engine's output is token-identical to the non-speculative path at
+``temperature == 0`` (acceptance verifies against the model's own
+argmax) and distributed EXACTLY as the non-speculative sampled path at
+``temperature > 0`` (rejection-sampling acceptance against the model's
+own target distribution, residual resample on rejection — see
+serving.engine._build_verify_program). A bad proposer costs throughput,
+never correctness (property-tested with an adversarial proposer in
+tests/test_serving.py).
+
+Draft probabilities: rejection sampling accepts draft token ``t`` drawn
+from a draft distribution ``q`` with probability ``min(1,
+p_target(t) / q(t))``. An n-gram proposal is DETERMINISTIC given the
+context — the "distribution" it samples from is the point mass on the
+proposed token, so its draft probabilities are exactly one-hot
+(``q(t) = 1``), the acceptance test collapses to ``u <= p_target(t)``,
+and the residual ``max(p - q, 0)`` is the target with the drafted
+token's mass removed. Because of this the engine never materializes a
+dense ``[S, spec_len, V]`` probability tensor for n-gram drafts — the
+one-hot is reconstructed IN-PROGRAM from the draft token ids, keeping
+the verify dispatch's entry-parameter traffic identical to the greedy
+program's. Proposers that genuinely sample (a real draft model) opt in
+to the dense path via the SoftProposer protocol below.
 """
 
 from __future__ import annotations
@@ -43,6 +60,45 @@ class Proposer(tp.Protocol):
         token (the engine materializes position ``len(ctx)`` itself, in-
         program, from the carried logits). Fewer than ``n`` (including
         zero) is fine: the verify dispatch masks the missing rows."""
+        ...
+
+
+class SoftProposer(tp.Protocol):
+    """A proposer that SAMPLES its drafts from a genuine distribution.
+
+    Marked by ``soft = True``; the engine then calls ``propose_soft``
+    and ships the returned ``[n_drafted, V]`` float32 probability rows
+    into the sampled verify dispatch as a dense entry tensor, so the
+    acceptance ratio ``u * q(t) <= p(t)`` and the residual
+    ``max(p - q, 0)`` see the proposer's true ``q``. Rejection-sampling
+    exactness is conditional on honesty: row j must be the distribution
+    token j was actually drawn from. The n-gram proposer never uses
+    this path (its q is one-hot by construction — see module
+    docstring); the dense path exists for draft-model proposers and for
+    the faithfulness tests' injectable soft-distribution proposers.
+
+    Why ``seed``: serving determinism requires drafting be a pure
+    function of the request — but honesty requires the draft actually
+    be DISTRIBUTED as q. A proposer derandomized by context alone is a
+    point mass given ctx (its true q is one-hot, whatever it claims):
+    two same-prompt requests would receive the identical "sample" and
+    the ensemble statistics rejection sampling relies on collapse. The
+    per-request sampling ``seed`` is exactly the entropy that resolves
+    this — derive the draft rng from ``(seed, ctx)`` and drafts stay
+    bitwise scheduling-invariant per request while remaining honest
+    draws from q across requests (the same contract the engine's own
+    sampler satisfies via derive_request_key)."""
+
+    soft: bool
+
+    def propose_soft(
+        self, ctx: tp.Sequence[int], n: int, seed: int
+    ) -> tp.Tuple[tp.List[int], tp.Any]:
+        """Like ``Proposer.propose`` but returns ``(tokens, probs)``
+        with ``probs`` array-like ``[len(tokens), V]`` — row j the draft
+        distribution token j was sampled from (rows must sum to 1).
+        ``seed`` is the request's sampling seed; the draft rng MUST be
+        derived from it (plus ctx), never from global state."""
         ...
 
 

@@ -99,8 +99,10 @@ def main() -> None:
     ap.add_argument(
         "--serve_spec", type=_positive_int, default=None,
         help="self-speculative decoding draft length in --serve mode "
-        "(n-gram prompt-lookup drafts verified in one dispatch; greedy "
-        "only — requires --temperature 0). Default off.",
+        "(n-gram prompt-lookup drafts verified in one dispatch; argmax "
+        "acceptance at --temperature 0, rejection-sampling acceptance "
+        "at --temperature > 0 — same stream contract either way). "
+        "Default off.",
     )
     ap.add_argument(
         "--serve_tp", type=_positive_int, default=None,
@@ -263,11 +265,6 @@ def main() -> None:
     if args.serve:
         from midgpt_tpu.serving import generate_served
 
-        if args.serve_spec and args.temperature != 0.0:
-            raise SystemExit(
-                "--serve_spec requires greedy decoding (--temperature 0): "
-                "speculative acceptance is argmax agreement"
-            )
         outs = generate_served(
             model,
             [prompt[i] for i in range(args.num_samples)],

@@ -13,7 +13,9 @@ clock, and emits ONE JSON record:
   serve_prefix_hit_rate  prompt tokens served from the prefix cache
   serve_prefill_tokens_saved / serve_prefill_tokens_computed
   serve_cow_copies       copy-on-write page duplications
-  serve_spec_acceptance_rate  drafted tokens the model's argmax accepted
+  serve_spec_acceptance_rate  drafted tokens the verify program accepted
+                         (argmax agreement at temperature 0, rejection
+                         sampling at temperature > 0)
   serve_verify_dispatches     speculative verify dispatches
   serve_quant            int8 quantized weight path on/off
   serve_peak_hbm_bytes   device peak HBM after the trace (null on CPU)
@@ -43,14 +45,20 @@ programs: the weight stream every decode step pays halves (bf16 -> int8
 bytes), which PERF.md r5's roofline puts at ~0.31 ms of the 0.43 ms
 124M B=8 floor — run --quant off/on on the same trace to ladder it.
 
-Self-speculative decoding (--spec on, greedy only): every decode
-dispatch drafts up to --spec_len tokens per request by n-gram lookup
-over the request's own history and verifies them in one dispatch —
+Self-speculative decoding (--spec on): every decode dispatch drafts up
+to --spec_len tokens per request by n-gram lookup over the request's
+own history and verifies them in one dispatch —
 serve_tokens_per_dispatch is the headline (1 + E[accepted] tokens per
-launch vs exactly 1 for --spec off at --window 1). Pair it with
---repetitive, which tiles each prompt from a short random pattern (the
-self-repeating traffic shape prompt-lookup drafting exists for); random
-incompressible prompts keep acceptance (and the win) near zero.
+launch vs exactly 1 for --spec off at --window 1). At --temperature 0
+acceptance is argmax agreement; at --temperature > 0 it is rejection
+sampling against the decode sampler's own distribution (same token
+distribution, same per-request key-derivation determinism — the
+sampled-chat leg the speedup was previously locked out of), and
+serve_spec_acceptance_rate reports the measured accept fraction either
+way. Pair it with --repetitive, which tiles each prompt from a short
+random pattern (the self-repeating traffic shape prompt-lookup drafting
+exists for); random incompressible prompts keep acceptance (and the
+win) near zero.
 
 A shared-system-prompt mix (--sys_prompt_len N) prepends one fixed
 N-token prefix to --sys_prompt_frac of all requests — the dominant
@@ -143,9 +151,22 @@ def main() -> None:
     ap.add_argument("--sys_prompt_frac", type=float, default=1.0)
     ap.add_argument("--spec", choices=("on", "off"), default="off",
                     help="self-speculative decoding (n-gram drafting + "
-                    "single-dispatch verification; greedy only)")
+                    "single-dispatch verification): argmax acceptance "
+                    "at --temperature 0, rejection-sampling acceptance "
+                    "at --temperature > 0 — same stream contract "
+                    "either way")
     ap.add_argument("--spec_len", type=int, default=8,
                     help="max draft tokens per verify dispatch (--spec on)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature (0 = greedy, the "
+                    "dispatch-arithmetic default): > 0 samples every "
+                    "emitted token from the temperature/top_k-shaped "
+                    "distribution with per-request (seed, token-index) "
+                    "key derivation, and composes with --spec on via "
+                    "rejection-sampling verification — the sampled-chat "
+                    "traffic shape")
+    ap.add_argument("--top_k", type=int, default=None,
+                    help="top-k sampling cutoff (--temperature > 0)")
     ap.add_argument("--repetitive", action="store_true",
                     help="tile each prompt from a short random pattern — "
                     "the self-repeating workload n-gram drafting targets")
@@ -300,6 +321,8 @@ def main() -> None:
         f"chunk={args.prefill_chunk or 'mono'} "
         f"sys={args.sys_prompt_len} "
         f"spec={args.spec_len if args.spec == 'on' else 'off'}"
+        f"{f' T={args.temperature:g}' if args.temperature else ''}"
+        f"{f' topk={args.top_k}' if args.top_k else ''}"
         f"{' rep' if args.repetitive else ''}"
         f" quant={args.quant} kv_quant={args.kv_quant}"
         f" kernel={args.paged_kernel} ls={args.layer_scan}"
@@ -523,7 +546,8 @@ def main() -> None:
         slots=args.slots,
         page_size=args.page_size,
         window=args.window,
-        temperature=0.0,
+        temperature=args.temperature,
+        top_k=args.top_k,
         seed=args.seed,
         prefix_cache=args.prefix_cache == "on",
         prefill_chunk=args.prefill_chunk or None,
@@ -1031,6 +1055,11 @@ def main() -> None:
         "serve_spec_drafted_tokens": st["spec_drafted_tokens"],
         "serve_spec_accepted_tokens": st["spec_accepted_tokens"],
         "serve_spec_acceptance_rate": st["spec_acceptance_rate"],
+        # sampling shape: temperature 0 = greedy; > 0 composes with
+        # --spec on via rejection-sampling verification, and the
+        # acceptance rate above is the sampled accept fraction
+        "serve_temperature": args.temperature,
+        "serve_top_k": args.top_k,
         # trace replay / SLO accounting (serving.frontdoor)
         "serve_trace": args.trace,
         "serve_slo_ms": args.slo_ms or None,

@@ -96,6 +96,21 @@ run serving_spec_off python scripts/bench_serving.py --platform=tpu \
 run serving_spec_on python scripts/bench_serving.py --platform=tpu \
   --repetitive --spec on --spec_len 8 \
   --out artifacts/bench_serving_spec_on.json
+# Sampled speculation rung pair (rejection-sampling verify, this PR):
+# the SAME repetitive trace at temperature 0.8 / top_k 20 with spec off
+# vs on, at the production serving precision (int8 weights + int8 KV) —
+# the sampled-chat traffic shape the greedy-only assert used to lock
+# out. serve_spec_acceptance_rate is the measured accept fraction of
+# the rejection sampler and serve_tokens_per_dispatch the headline;
+# PERF.md's E[accepted]+1 arithmetic is stated against this pair.
+run serving_spec_sampled_off python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on \
+  --repetitive --window 8 --spec off --temperature 0.8 --top_k 20 \
+  --out artifacts/bench_serving_spec_sampled_off.json
+run serving_spec_sampled_on python scripts/bench_serving.py \
+  --platform=tpu --quant on --kv_quant on \
+  --repetitive --spec on --spec_len 8 --temperature 0.8 --top_k 20 \
+  --out artifacts/bench_serving_spec_sampled_on.json
 # Int8 quantized weight path (PR 6): identical trace with the bf16 vs
 # int8 weight stream — serve_tok_s measures the halved-weight-stream
 # floor move (~0.43 -> ~0.27 ms/step at 124M B=8 per PERF.md's roofline

@@ -475,3 +475,97 @@ def test_prover_catches_bf16_accumulating_kernel(monkeypatch):
             "to the prover"
         ] is False
     )
+
+
+# ---------------------------------------------------------------------------
+# the sampled-verify prover (temperature > 0): the verify program's
+# rejection-sampling arithmetic proven against the decode window's
+# sampler, plus the acceptance-compare dtype fault injection
+# ---------------------------------------------------------------------------
+
+_SAMPLED_CHECKS = (
+    "sampled: verify row-0 sampler mirrors the decode window's "
+    "categorical",
+    "sampled: acceptance compares run in f32",
+    "sampled: residual renormalization runs in f32",
+    "sampled: target softmax runs in f32 in the verify sampler",
+)
+
+
+@pytest.fixture(scope="module")
+def sampled_report():
+    return prove_serving_choreography(
+        "openwebtext", temperature=0.8, top_k=20
+    )
+
+
+def test_sampled_prover_passes_on_current_tree(sampled_report):
+    assert sampled_report.ok, "\n".join(
+        f"{c.name}: {c.detail}"
+        for c in sampled_report.checks
+        if not c.ok
+    )
+    checks = _checks(sampled_report)
+    for name in _SAMPLED_CHECKS:
+        assert checks[name] is True, name
+
+
+def test_sampled_checks_ride_next_to_the_greedy_contracts(
+    healthy_report, sampled_report
+):
+    """The T>0 report is the greedy report's check set PLUS the four
+    sampled clauses — the greedy choreography contracts (verify mirrors
+    decode, f32 softmax, mask-before-scale, ...) must keep being proven
+    on the sampled programs, and the greedy report must NOT grow
+    sampled clauses (there is no sampler to extract at argmax)."""
+    greedy = set(_checks(healthy_report))
+    sampled = set(_checks(sampled_report))
+    assert sampled == greedy | set(_SAMPLED_CHECKS)
+    assert not greedy & set(_SAMPLED_CHECKS)
+
+
+def test_sampled_prover_passes_on_quant_kernel_cell():
+    """One production-precision sampled cell (int8 weights + int8 KV +
+    Pallas kernel) — the composition the CI matrix proves exhaustively;
+    this pins it in the suite so a local regression fails fast."""
+    rep = prove_serving_choreography(
+        "openwebtext", quant=True, kv_quant=True, paged_kernel="pallas",
+        temperature=0.8, top_k=20,
+    )
+    assert rep.ok, "\n".join(
+        f"{c.name}: {c.detail}" for c in rep.checks if not c.ok
+    )
+
+
+def test_sampled_prover_catches_bf16_acceptance_compare(monkeypatch):
+    """Fault injection (the ISSUE 17 clause): re-introduce a
+    drifted-dtype acceptance compare — the rejection test
+    ``u * q <= p`` evaluated in bf16 — and the prover must fail EXACTLY
+    the acceptance-compare clause while every sibling sampled clause
+    stays green (the fault is in the compare, not in the categorical,
+    the residual, or the softmax)."""
+    from midgpt_tpu import sampling as sampling_mod
+
+    def bf16_acceptance(u, q_sel, p_sel):
+        return (
+            u.astype(jnp.bfloat16) * q_sel.astype(jnp.bfloat16)
+        ) <= p_sel.astype(jnp.bfloat16)
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(sampling_mod, "acceptance_mask", bf16_acceptance)
+    try:
+        rep = prove_serving_choreography(
+            "openwebtext", temperature=0.8, top_k=20
+        )
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks["sampled: acceptance compares run in f32"] is False
+    for name in _SAMPLED_CHECKS:
+        if name != "sampled: acceptance compares run in f32":
+            assert checks[name] is True, name
+    detail = {c.name: c.detail for c in rep.checks}[
+        "sampled: acceptance compares run in f32"
+    ]
+    assert "bfloat16" in detail

@@ -9,6 +9,7 @@ reference (its sampler is fixed-batch, full-re-forward per token,
 sample.py:68-95)."""
 
 import dataclasses
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -1023,10 +1024,18 @@ def test_spec_eos_mid_verify_matches_spec_off():
     assert int(on[-1]) == eos and eos not in on[:-1].tolist()
 
 
-def test_spec_requires_greedy():
+def test_sampling_config_typed_errors():
+    """Sampled speculation is supported (the greedy-only assert is
+    gone): the ctor builds the rejection-sampling verify program at
+    temperature > 0. Only genuinely invalid sampling configs raise, and
+    they raise TYPED errors."""
     model = _model()
-    with pytest.raises(AssertionError):
-        ServingEngine(model, slots=1, temperature=0.8, speculate=4)
+    eng = ServingEngine(model, slots=1, temperature=0.8, speculate=4)
+    assert eng.temperature == 0.8 and eng.speculate == 4
+    with pytest.raises(ValueError, match="temperature"):
+        ServingEngine(model, slots=1, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_k"):
+        ServingEngine(model, slots=1, temperature=0.8, top_k=0)
 
 
 @pytest.mark.slow
@@ -1096,3 +1105,274 @@ def test_decode_window_audit_donation_and_host_sync():
     assert report.ok, report.violations
     assert analysis.donated_leaves == 3  # pool.k, pool.v, logits
     assert len({e.param_number for e in analysis.aliases}) >= 3
+
+
+# ---------------------------------------------------------------------------
+# Sampled speculation (temperature > 0): rejection-sampling verify
+# ---------------------------------------------------------------------------
+#
+# At temperature > 0 spec-on is NOT bitwise spec-off (accepted drafts are
+# draws from the proposer's q, not fresh draws from p) — the contract is
+# (a) SCHEDULING INVARIANCE: the sampled spec-on stream is a pure function
+#     of (request seed, engine seed, sampling knobs), bitwise identical
+#     across slots / window / batch composition / chunking / prefix cache /
+#     eviction / layer_scan — within each arithmetic cell (kv-quant changes
+#     the arithmetic, so cells are compared within themselves, exactly like
+#     the greedy layer_scan matrix above);
+# (b) DISTRIBUTIONAL EXACTNESS: accept-with-min(1, p/q) + residual
+#     resample + bonus row reproduce the spec-off sampling distribution for
+#     ANY honest proposer (statistical test below);
+# (c) DEGENERATE ANCHOR: with no drafts the verify program IS the decode
+#     sampler — bitwise spec-off.
+
+
+def _rep_prompts(n, period=4, reps=6):
+    """Repetitive-text prompts (the fixture the n-gram proposer can
+    actually draft against)."""
+    return [
+        np.tile(
+            np.asarray(
+                jax.random.randint(
+                    jax.random.PRNGKey(700 + i), (period,), 0,
+                    CFG.vocab_size,
+                )
+            ),
+            reps,
+        )
+        for i in range(n)
+    ]
+
+
+def _run_sampled(model, prompts, lens, **kw):
+    """One sampled spec-on rollout; returns (streams, engine)."""
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("slots", 2)
+    kw.setdefault("window", 4)
+    kw.setdefault("speculate", 4)
+    eng = ServingEngine(model, temperature=0.8, top_k=20, seed=3, **kw)
+    rids = [
+        eng.submit(p, n, seed=i)
+        for i, (p, n) in enumerate(zip(prompts, lens))
+    ]
+    fin = eng.run()
+    eng.alloc.check()
+    assert eng.alloc.held_pages == 0
+    return [list(map(int, fin[r].tokens)) for r in rids], eng
+
+
+class _EmptyProposer:
+    """Never drafts: every verify dispatch degenerates to row 0."""
+
+    def propose(self, ctx, n):
+        return []
+
+
+class _SoftModelProposer:
+    """Honest soft-distribution proposer (serving.speculate.SoftProposer
+    protocol): each draft is genuinely SAMPLED from the claimed q row —
+    the rejection-sampling exactness precondition — with q computed by
+    the monolithic full-precision forward at ``q_temperature`` (defaults
+    to the verify temperature: a near-oracle whose only p/q mismatch is
+    the paged verify arithmetic; a flatter ``q_temperature`` forces
+    heavy rejection and drives real mass through the residual resample
+    without breaking exactness). Drafting is derandomized from
+    (request seed, context) — crc32-seeded numpy rng, NOT Python
+    ``hash`` (salted per process) — so drafts are a pure function of
+    the request and cannot perturb scheduling invariance, while staying
+    honest draws from q ACROSS requests (the seed is the per-request
+    entropy; ctx alone would collapse same-prompt requests onto one
+    deterministic draft and break rejection-sampling exactness — the
+    reason propose_soft receives the seed at all)."""
+
+    soft = True
+
+    def __init__(self, model, temperature, top_k, q_temperature=None):
+        self.model = model
+        self.temperature = (
+            temperature if q_temperature is None else q_temperature
+        )
+        self.top_k = top_k
+        self._fwd = jax.jit(lambda m, x: m(x))
+
+    def _dist(self, toks):
+        from midgpt_tpu.sampling import target_probs
+
+        toks = list(toks)[-CFG.block_size:]
+        # fixed-shape forward: causal attention ignores the zero padding
+        # after position len(toks) - 1, and one compile serves every call
+        x = np.zeros((1, CFG.block_size), np.int32)
+        x[0, : len(toks)] = toks
+        logits = self._fwd(self.model, jnp.asarray(x))[0, len(toks) - 1]
+        q = np.asarray(
+            target_probs(logits, self.temperature, self.top_k), np.float64
+        )
+        return q / q.sum()
+
+    def propose(self, ctx, n):  # greedy path: unused at temperature > 0
+        return []
+
+    def propose_soft(self, ctx, n, seed):
+        if n <= 0:
+            return [], np.zeros((0, CFG.vocab_size), np.float32)
+        ctx = [int(t) for t in ctx]
+        rng = np.random.default_rng(
+            (seed, zlib.crc32(np.asarray(ctx, np.int64).tobytes()))
+        )
+        # drafts cover positions len(ctx)+1.. (verify row 0 samples
+        # position len(ctx) itself), so guess the skipped token first —
+        # a wrong guess only costs acceptance, never exactness
+        skip = int(rng.choice(CFG.vocab_size, p=self._dist(ctx)))
+        toks, qs = [], []
+        for _ in range(n):
+            q = self._dist(ctx + [skip] + toks)
+            toks.append(int(rng.choice(CFG.vocab_size, p=q)))
+            qs.append(q.astype(np.float32))
+        return toks, np.stack(qs)
+
+
+def test_spec_sampled_stream_invariant_to_scheduling():
+    """Contract (a), fast tier: sampled spec-on streams are bitwise
+    invariant to slots / prefix cache / chunked prefill — drafts ride
+    the n-gram proposer against repetitive prompts, so acceptance AND
+    rejection-residual paths both execute."""
+    model = _model()
+    prompts = _rep_prompts(3)
+    lens = [10, 12, 8]
+    a, ea = _run_sampled(model, prompts, lens, slots=2, prefix_cache=True)
+    b, _ = _run_sampled(
+        model, prompts, lens, slots=1, prefix_cache=False, prefill_chunk=8
+    )
+    assert a == b
+    assert ea.spec_drafted > 0, "repetitive fixture must actually draft"
+    assert all(len(t) == n for t, n in zip(a, lens))
+
+
+def test_spec_sampled_no_drafts_is_bitwise_spec_off():
+    """Contract (c): with a proposer that never drafts, every verify
+    dispatch degenerates to the decode sampler — the sampled spec-on
+    stream is BITWISE the spec-off stream (same derived per-request
+    keys, same arithmetic). This anchors the verify program's row-0
+    sampler to the plain window."""
+    model = _model()
+    prompts = _prompts(3)
+    lens = [8, 10, 6]
+    off, _ = _run_sampled(model, prompts, lens, speculate=0)
+    on, eng = _run_sampled(
+        model, prompts, lens, speculate=4, proposer=_EmptyProposer()
+    )
+    assert on == off
+    assert eng.spec_drafted == 0
+
+
+def test_spec_sampled_soft_proposer_dispatch_win():
+    """The perf claim at temperature > 0: a near-oracle soft proposer
+    (q ~= p) gets drafts ACCEPTED through the rejection sampler, so a
+    single slot emits more than one token per decode dispatch on the
+    repetitive-prompt fixture — E[accepted] + 1 per verify launch."""
+    model = _model()
+    prompt = _rep_prompts(1)[0]
+    n_new = 16
+    prop = _SoftModelProposer(model, 0.8, 20)
+    eng = ServingEngine(
+        model, slots=1, page_size=8, window=4, temperature=0.8, top_k=20,
+        cache_dtype=jnp.float32, speculate=4, proposer=prop, seed=3,
+    )
+    rid = eng.submit(prompt, n_new, seed=0)
+    fin = eng.run()
+    assert len(fin[rid].tokens) == n_new
+    st = eng.stats()
+    assert st["spec_accepted_tokens"] > 0, st
+    assert st["tokens_per_dispatch"] > 1.0, st
+    assert st["decode_dispatches"] < n_new, st
+
+
+@pytest.mark.slow
+def test_spec_sampled_invariance_matrix_slow():
+    """Contract (a), full single-chip matrix: within each arithmetic
+    cell (f32 pool; int8-quantized bf16 pool) the sampled spec-on
+    stream is bitwise identical across slots, prefix cache on/off,
+    chunked prefill, page pressure with eviction/re-admission, and
+    layer_scan on/off. Cross-cell equality is NOT asserted — kv-quant
+    changes the arithmetic (same contract as the greedy layer_scan
+    matrix). tp=2 rides test_serving_sharded.py."""
+    model = _model()
+    prompts = _rep_prompts(3)
+    lens = [10, 12, 8]
+    scheds = (
+        dict(slots=2, prefix_cache=True),
+        dict(slots=1, prefix_cache=False),
+        dict(slots=3, prefill_chunk=8),
+        dict(slots=2, prefill_chunk=5, num_pages=7, prefix_cache=True),
+    )
+    for arith in (
+        dict(cache_dtype=jnp.float32),
+        dict(kv_quant="int8", cache_dtype=jnp.bfloat16),
+    ):
+        base = None
+        for ls in ("off", "on"):
+            for sched in scheds:
+                toks, eng = _run_sampled(
+                    model, prompts, lens, layer_scan=ls, **arith, **sched
+                )
+                if "num_pages" in sched:
+                    assert eng.evictions > 0, (
+                        "pressure leg was sized to evict"
+                    )
+                if base is None:
+                    base = toks
+                assert toks == base, (arith, ls, sched)
+
+
+@pytest.mark.slow
+def test_spec_sampled_statistical_faithfulness_slow():
+    """Contract (b): distributional exactness of accept / residual /
+    bonus. The proposer claims a DELIBERATELY mismatched q (flatter:
+    q_temperature 1.6 vs verify 0.8), so a large fraction of drafts
+    reject and the residual resample carries real probability mass —
+    exactness must come from the rejection arithmetic, not from q ~= p.
+    Over a seed ensemble: position 0 is bitwise spec-off (same derived
+    key, same carried prefill logits); later positions pass two-sample
+    TV + pooled chi-square gates sized generously above the N-sample
+    noise floor (expected TV ~ sqrt(k / (pi N)) ~= 0.13 at k = 20,
+    N = 300; deterministic seeds, no flake)."""
+    model = _model()
+    prompt = _prompts(1, base_len=8)[0]
+    N, n_new = 300, 3
+
+    def ensemble(**kw):
+        eng = ServingEngine(
+            model, slots=4, page_size=8, window=4, temperature=0.8,
+            top_k=20, cache_dtype=jnp.float32, prefix_cache=True, seed=3,
+            **kw,
+        )
+        rids = [eng.submit(prompt, n_new, seed=i) for i in range(N)]
+        fin = eng.run()
+        return np.asarray([fin[r].tokens for r in rids]), eng
+
+    off, _ = ensemble()
+    on, eng = ensemble(
+        speculate=3,
+        proposer=_SoftModelProposer(model, 0.8, 20, q_temperature=1.6),
+    )
+    st = eng.stats()
+    assert st["spec_drafted_tokens"] > 0
+    # the mismatched q must actually reject (residual path under test)
+    assert st["spec_acceptance_rate"] < 0.9, st
+    np.testing.assert_array_equal(on[:, 0], off[:, 0])
+    for j in range(1, n_new):
+        ca = np.bincount(off[:, j], minlength=CFG.vocab_size)
+        cb = np.bincount(on[:, j], minlength=CFG.vocab_size)
+        tv = 0.5 * np.abs(ca / N - cb / N).sum()
+        assert tv < 0.25, (j, tv)
+        # pooled two-sample chi-square, no scipy: merge cells with < 10
+        # pooled counts, stat ~ chi2(df) under H0, gate at ~4 sigma
+        pooled = ca + cb
+        big = pooled >= 10
+        a = np.append(ca[big], ca[~big].sum()).astype(np.float64)
+        b = np.append(cb[big], cb[~big].sum()).astype(np.float64)
+        keep = (a + b) > 0
+        a, b = a[keep], b[keep]
+        stat = ((a - b) ** 2 / (a + b)).sum()
+        df = max(len(a) - 1, 1)
+        assert stat < df + 4.0 * np.sqrt(2.0 * df), (j, stat, df)

@@ -207,6 +207,34 @@ def test_tp2_eviction_readmission_identity(model):
     assert got == ref
 
 
+def test_tp2_sampled_spec_token_identity(model):
+    """Sampled speculation (temperature > 0: rejection-sampling verify)
+    under tp=2: the spec-on sampled stream is bitwise the single-chip
+    engine's on a repetitive-prompt trace (drafts, acceptance uniforms
+    and residual resamples are all functions of (request seed, stream
+    position) only — the mesh must not enter the stream). Same seeded-
+    contract regime as the greedy matrix: sharding only reorders the
+    two row-parallel psums, and the f32 cache keeps the sampled
+    compare margins wide."""
+    prompts = [
+        np.tile(
+            np.asarray(
+                jax.random.randint(
+                    jax.random.PRNGKey(700 + i), (4,), 0, CFG.vocab_size
+                )
+            ),
+            6,
+        )
+        for i in range(3)
+    ]
+    kw = dict(temperature=0.8, top_k=20, speculate=3, seed=3)
+    ref, re_ = _run(model, None, prompts, 10, **kw)
+    got, ge = _run(model, _mesh(2), prompts, 10, **kw)
+    assert got == ref
+    assert ge.spec_drafted > 0, "repetitive trace must actually draft"
+    assert ge.spec_drafted == re_.spec_drafted
+
+
 def test_engine_rejects_unservable_meshes(model):
     """Serving meshes are tensor-only: sequence/pipeline axes and tp
     degrees that break whole-head or vocab divisibility are refused at

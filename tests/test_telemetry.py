@@ -531,6 +531,41 @@ def test_bench_serving_telemetry_record_contract(tmp_path):
     assert tr["traceEvents"]
 
 
+@pytest.mark.slow
+def test_bench_serving_sampled_spec_record_contract(tmp_path):
+    """--temperature composed with --spec on (rejection-sampling
+    verification): the record must carry the sampling shape next to the
+    speculation counters — the surface the r6 queue's spec-sampled rung
+    pair and the serving-choreo sampled-chat CI leg consume."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "rec_sampled.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "bench_serving.py"),
+         "--preset", "tiny", "--spec", "on", "--spec_len", "4",
+         "--temperature", "0.8", "--top_k", "20", "--repetitive",
+         "--window", "2", "--deadline_s", "600", "--out", out],
+        capture_output=True, text=True, env=env, timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.load(open(out))
+    assert rec["status"] == "ok"
+    assert rec["serve_temperature"] == 0.8
+    assert rec["serve_top_k"] == 20
+    # every decode dispatch IS a verify dispatch with spec on, and the
+    # acceptance rate is the rejection sampler's measured accept
+    # fraction (a float even when the random-init model accepts none)
+    assert rec["serve_verify_dispatches"] > 0
+    assert rec["serve_spec_drafted_tokens"] > 0
+    assert rec["serve_spec_acceptance_rate"] is not None
+    assert "T=0.8" in rec["serve_shape"]
+    assert "topk=20" in rec["serve_shape"]
+
+
 # ---------------------------------------------------------------------------
 # Shared substrate (PR 15): serving re-exports the midgpt_tpu.telemetry
 # core unchanged, and the Prometheus exporter renders registry
