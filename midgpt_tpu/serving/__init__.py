@@ -30,7 +30,19 @@ Public surface:
   cluster runs N shared-nothing engine replicas (least-loaded admission,
   per-replica prefix caches, aggregated stats) above it — with
   per-replica health, a dispatch watchdog, transient-error retry, and
-  bit-identical failover of a dead replica's backlog.
+  bit-identical failover of a dead replica's backlog. Disaggregated
+  serving rides the same seam:
+  ``ServingCluster(prefill_replicas=P, decode_replicas=D)`` splits the
+  pools by roofline (compute-bound prefill vs HBM-bound decode), pages
+  hand off between them via
+  :func:`~midgpt_tpu.serving.paged.export_pages` /
+  :func:`~midgpt_tpu.serving.paged.import_pages`
+  (:class:`~midgpt_tpu.serving.engine.HandoffRecord` carries payloads,
+  int8 scale planes, and the final prefill logits row — decode resumes
+  bit-identically), and ``affinity=True`` routes admission to the
+  replica with the longest resident-prefix overlap (load-imbalance
+  capped; :class:`~midgpt_tpu.serving.faults.HandoffFailed` is the
+  typed fault for a handoff that dies mid-flight).
 - :class:`~midgpt_tpu.serving.faults.FaultPlan` and the typed failure
   surface (:class:`~midgpt_tpu.serving.faults.AdmissionRejected`,
   :class:`~midgpt_tpu.serving.faults.PoolOverloaded`, the replica fault
@@ -78,6 +90,7 @@ from midgpt_tpu.serving.faults import (
     DeadlineExceeded,
     FaultEvent,
     FaultPlan,
+    HandoffFailed,
     PoolOverloaded,
     ReplicaCrash,
     ServingFault,
@@ -90,6 +103,7 @@ from midgpt_tpu.serving.frontdoor import (
     VirtualClock,
 )
 from midgpt_tpu.serving.engine import (
+    HandoffRecord,
     Request,
     ServingEngine,
     make_copy_page_program,
@@ -110,7 +124,9 @@ from midgpt_tpu.serving.paged import (
     PagedKVPool,
     PrefixIndex,
     copy_page,
+    export_pages,
     flush_recent,
+    import_pages,
     pages_needed,
     write_prompt_pages,
     write_token_rows,
@@ -127,6 +143,8 @@ __all__ = [
     "EngineTelemetry",
     "FaultEvent",
     "FaultPlan",
+    "HandoffFailed",
+    "HandoffRecord",
     "MetricsRegistry",
     "NgramProposer",
     "PageAllocator",
@@ -146,8 +164,10 @@ __all__ = [
     "chrome_trace",
     "copy_page",
     "serving_meshes",
+    "export_pages",
     "flush_recent",
     "generate_served",
+    "import_pages",
     "make_copy_page_program",
     "make_decode_window",
     "make_prefill_chunk_program",

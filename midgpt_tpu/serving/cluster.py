@@ -42,11 +42,31 @@ this TPxDP composition).
   contract, and placement invariance makes the surviving stream equal
   to the fault-free run token for token — the chaos suite proves it,
   not just asserts it plausible.
-- **Per-replica prefix caches**: no cross-replica page sharing (pages
-  live in per-replica pools on disjoint devices). A shared-prefix mix
-  therefore hits best when co-located; the least-loaded policy is
-  deliberately content-blind — smarter affinity routing is a policy
-  plug-in point, not an engine change.
+- **Per-replica prefix caches + affinity routing**: no cross-replica
+  page sharing (pages live in per-replica pools on disjoint devices),
+  so a shared-prefix mix hits best when co-located. ``affinity=True``
+  turns admission content-aware: the cluster probes each candidate
+  replica's :class:`PrefixIndex` (``match`` is read-only — probing
+  perturbs nothing) and routes to the longest resident-prefix overlap,
+  bounded by a load-imbalance cap (``affinity_max_imbalance``) so
+  affinity can never starve a replica; zero overlap falls back to
+  least-loaded. Placement still never changes tokens — only hit rate
+  and latency — so every determinism/failover contract is untouched.
+- **Disaggregated prefill/decode pools**
+  (``prefill_replicas=``/``decode_replicas=``): the first P replicas
+  run ``role="prefill"`` engines (chunked prefill to completion, then
+  the slot parks handoff-ready), the next D run ``role="decode"``.
+  After every scheduler round the cluster PUMPS handoffs: each ready
+  slot exports its block-table pages + carried logits row
+  (``engine.export_request`` → :class:`HandoffRecord`, host arrays —
+  the honest DCN wire model) and imports into the least-loaded decode
+  replica (``engine.import_request``), which aliases whatever prefix
+  its own index already holds and resumes decoding bit-identically.
+  Admission and failover target the prefill pool (decode replicas
+  receive work only via handoff), degrading to any alive replica when
+  the whole prefill pool is dead. A scripted ``handoff`` fault raises
+  :class:`HandoffFailed` at export — the source copy is abandoned and
+  the request re-serves COLD from the submission record, same stream.
 - **Aggregated stats**: :meth:`stats` sums the per-engine counters and
   keeps the per-replica breakdown, in the same key layout as
   ``ServingEngine.stats`` (bench_serving emits it unchanged), plus the
@@ -70,12 +90,13 @@ import typing as tp
 
 import numpy as np
 
-from midgpt_tpu.serving.engine import Request, ServingEngine
+from midgpt_tpu.serving.engine import HandoffRecord, Request, ServingEngine
 from midgpt_tpu.serving.telemetry import EngineTelemetry
 from midgpt_tpu.serving.faults import (
     AdmissionRejected,
     ClusterUnavailable,
     FaultPlan,
+    HandoffFailed,
     PoolOverloaded,
     ReplicaCrash,
     TransientDispatchError,
@@ -140,6 +161,23 @@ class ServingCluster:
     multiplexes. All other keyword arguments go to every engine
     verbatim.
 
+    Disaggregation + routing knobs:
+
+    - ``prefill_replicas=P, decode_replicas=D`` — disaggregated pools:
+      the first P replicas run ``role="prefill"`` (chunked prefill to
+      completion, then the slot parks handoff-ready), the last D run
+      ``role="decode"``; the cluster pumps page handoffs between them
+      after every scheduler round. Pool split never changes tokens —
+      the disagg test matrix proves 1+1 / 2+1 / 2+2 bit-identical to
+      the monolithic engine.
+    - ``affinity=True`` — prefix-affinity admission: route to the
+      replica whose :class:`PrefixIndex` holds the longest resident
+      prefix of the prompt, bounded by ``affinity_max_imbalance``
+      (max backlog gap vs the least-loaded replica a hit may justify;
+      zero overlap falls back to pure least-loaded). Off by default:
+      placement order is part of the replay-determinism surface the
+      existing tests pin, so content-aware routing is opt-in.
+
     Fault-tolerance knobs:
 
     - ``dispatch_timeout_s`` — wall-clock watchdog per replica step;
@@ -163,6 +201,10 @@ class ServingCluster:
         *,
         replicas: tp.Optional[int] = None,
         meshes: tp.Optional[tp.Sequence] = None,
+        prefill_replicas: tp.Optional[int] = None,
+        decode_replicas: tp.Optional[int] = None,
+        affinity: bool = False,
+        affinity_max_imbalance: int = 4,
         fault_plan: tp.Optional[FaultPlan] = None,
         dispatch_timeout_s: tp.Optional[float] = None,
         max_retries: int = 3,
@@ -171,9 +213,33 @@ class ServingCluster:
         flight_dir: tp.Optional[str] = None,
         **engine_kwargs,
     ):
+        # disaggregated mode: the first P replicas prefill, the next D
+        # decode (replica index order = [prefill pool | decode pool],
+        # so meshes= pins pools to device groups positionally)
+        roles: tp.Optional[tp.List[str]] = None
+        if prefill_replicas is not None or decode_replicas is not None:
+            assert (
+                prefill_replicas is not None and prefill_replicas >= 1
+                and decode_replicas is not None and decode_replicas >= 1
+            ), (
+                "disaggregated mode needs BOTH prefill_replicas>=1 and "
+                f"decode_replicas>=1, got {prefill_replicas}+"
+                f"{decode_replicas}"
+            )
+            total = prefill_replicas + decode_replicas
+            assert replicas is None or replicas == total, (
+                f"replicas={replicas} contradicts "
+                f"{prefill_replicas}+{decode_replicas} pools"
+            )
+            replicas = total
+            roles = (
+                ["prefill"] * prefill_replicas
+                + ["decode"] * decode_replicas
+            )
         if meshes is None:
             assert replicas is not None and replicas >= 1, (
-                "need replicas=N or an explicit meshes= list"
+                "need replicas=N, prefill_replicas=P + decode_replicas=D, "
+                "or an explicit meshes= list"
             )
             meshes = [None] * replicas
         else:
@@ -206,9 +272,26 @@ class ServingCluster:
         self.engines: tp.List[ServingEngine] = []
         for i, m in enumerate(meshes):
             kw = dict(engine_kwargs)
+            if roles is not None:
+                kw["role"] = roles[i]
             if fault_plan is not None:
                 kw["fault_hook"] = fault_plan.hook(i)
             self.engines.append(ServingEngine(model, mesh=m, **kw))
+        # pool topology + routing policy
+        self.disaggregated = roles is not None
+        self.prefill_replicas = int(prefill_replicas or 0)
+        self.decode_replicas = int(decode_replicas or 0)
+        self._prefill_pool = (
+            list(range(self.prefill_replicas)) if self.disaggregated
+            else list(range(len(self.engines)))
+        )
+        self._decode_pool = (
+            list(range(self.prefill_replicas, len(self.engines)))
+            if self.disaggregated else []
+        )
+        self.affinity = bool(affinity)
+        self.affinity_max_imbalance = int(affinity_max_imbalance)
+        assert self.affinity_max_imbalance >= 0
         self.dispatch_timeout_s = dispatch_timeout_s
         self.max_retries = max_retries
         self.backoff_s = backoff_s
@@ -225,12 +308,25 @@ class ServingCluster:
         self.retries = 0
         self.failovers = 0
         self.requeued_requests = 0
+        # disaggregation + routing counters (CLUSTER_STATS_KEYS)
+        self.handoffs = 0
+        self.handoff_pages_moved = 0
+        self.handoff_bytes = 0
+        self.handoff_failures = 0
+        self.prefix_affinity_hits = 0
+        self.routed_fallback = 0
         self.first_fault_time: tp.Optional[float] = None
         # global rid -> (replica index, engine-local rid)
         self._route: tp.Dict[int, tp.Tuple[int, int]] = {}
-        # global rid -> (prompt, max_new_tokens, eos_id, seed): the cold
-        # failover record (dropped at harvest)
+        # global rid -> (prompt, max_new_tokens, eos_id, seed, submit
+        # time, priority, deadline, routing decision): the cold failover
+        # record (dropped at harvest)
         self._submitted: tp.Dict[int, tp.Tuple] = {}
+        # global rid -> HandoffRecord: exported off a prefill replica,
+        # awaiting a decode-pool slot (the route re-points on import; a
+        # record in limbo is self-contained host data, so it survives
+        # the death of its source replica)
+        self._handoff: tp.Dict[int, HandoffRecord] = {}
         self._next_rid = 0
         self.finished: tp.Dict[int, Request] = {}
         # post-admission terminal outcomes that are not completions
@@ -271,9 +367,10 @@ class ServingCluster:
     @property
     def has_work(self) -> bool:
         """Un-harvested cluster requests remain. Routes outlive replica
-        deaths (failover re-points them at survivors), so this is the
-        drain condition even mid-failover."""
-        return bool(self._route) or any(
+        deaths (failover re-points them at survivors) and a pending
+        handoff record is a live request between pools, so this is the
+        drain condition even mid-failover/mid-handoff."""
+        return bool(self._route) or bool(self._handoff) or any(
             self.engines[i].has_work for i in self._alive()
         )
 
@@ -286,6 +383,67 @@ class ServingCluster:
 
     def _least_loaded(self, alive: tp.Sequence[int]) -> int:
         return min(alive, key=lambda j: (self._load(self.engines[j]), j))
+
+    def _submit_targets(self) -> tp.List[int]:
+        """Replicas admission (and cold re-serve) may target: the alive
+        prefill pool when disaggregated — decode replicas only receive
+        work via handoff — degrading to ANY alive replica when the
+        whole prefill pool is dead (a decode-class engine is a full
+        engine: it can prefill and decode, just off its roofline)."""
+        alive = self._alive()
+        if not self.disaggregated:
+            return alive
+        pool = [i for i in self._prefill_pool if self.health[i] != "dead"]
+        return pool or alive
+
+    def _affinity_overlap(self, j: int, toks: tp.Sequence[int]) -> int:
+        """Longest resident-prefix overlap (in tokens) replica ``j``
+        holds for this prompt — the per-replica sketch the affinity
+        router reads is the engine's own :class:`PrefixIndex`, probed
+        directly: ``match`` is read-only (no LRU mutation), so probing
+        every candidate perturbs nothing and needs no shadow state that
+        could drift from the pool it describes."""
+        idx = self.engines[j].index
+        if idx is None or not toks:
+            return 0
+        return int(idx.match(list(toks))[2])
+
+    def _route_order(
+        self,
+        cands: tp.Sequence[int],
+        prompt: np.ndarray,
+        max_new_tokens: int,
+    ) -> tp.Tuple[tp.List[int], int]:
+        """Candidate replicas in admission-preference order, plus the
+        best resident-prefix overlap (0 when affinity is off or
+        nothing matched). Affinity picks the longest overlap among
+        replicas within ``affinity_max_imbalance`` of the minimum load
+        (ties: least loaded, then lowest index) and puts it FIRST —
+        the least-loaded order follows as the spillover tail, so a
+        full queue on the affinity target degrades exactly like the
+        blind policy. The overlap probe crops the prompt exactly like
+        ``engine.submit`` will (block - max_new window, last-prompt
+        token excluded), so it scores the tokens the engine would
+        actually admit against its cache."""
+        loads = {j: self._load(self.engines[j]) for j in cands}
+        order = sorted(cands, key=lambda j: (loads[j], j))
+        if not self.affinity:
+            return order, 0
+        pp = np.asarray(prompt, np.int32).reshape(-1)
+        keep = self.engines[order[0]].block - max_new_tokens
+        if 0 < keep < pp.size:
+            pp = pp[-keep:]
+        toks = [int(t) for t in pp[:-1]] if pp.size > 1 else []
+        cap = loads[order[0]] + self.affinity_max_imbalance
+        eligible = [j for j in cands if loads[j] <= cap]
+        best = max(
+            eligible,
+            key=lambda j: (self._affinity_overlap(j, toks), -loads[j], -j),
+        )
+        overlap = self._affinity_overlap(best, toks)
+        if overlap > 0:
+            order = [best] + [j for j in order if j != best]
+        return order, overlap
 
     def submit(
         self,
@@ -300,7 +458,10 @@ class ServingCluster:
     ) -> int:
         """Admit onto the least-loaded HEALTHY replica (lowest index on
         ties — deterministic, so a test trace routes identically every
-        run); returns the cluster-global request id. Raises
+        run); with ``affinity=True`` the replica with the longest
+        resident-prefix overlap is preferred within the load-imbalance
+        cap, and in disaggregated mode only the prefill pool is
+        targeted. Returns the cluster-global request id. Raises
         :class:`ClusterUnavailable` when every replica is dead, and
         passes the engine's typed admission outcomes
         (``AdmissionRejected``/``PoolOverloaded``) through to the
@@ -316,11 +477,10 @@ class ServingCluster:
         attempts; the request is only actually shed/deferred when the
         LAST replica refuses.) Permanent rejections are identical on
         every replica and re-raise immediately."""
-        alive = self._alive()
-        if not alive:
+        if not self._alive():
             raise ClusterUnavailable("every replica is dead")
-        order = sorted(
-            alive, key=lambda j: (self._load(self.engines[j]), j)
+        order, overlap = self._route_order(
+            self._submit_targets(), prompt, max_new_tokens
         )
         # the ABSOLUTE deadline is fixed here, at first cluster
         # admission (unless the caller anchored it earlier — e.g. the
@@ -342,6 +502,24 @@ class ServingCluster:
                 if exc.reason != "queue_full" or n == len(order) - 1:
                     raise
         assert local is not None
+        # the routing decision is scored at the replica that actually
+        # admitted: a queue_full spillover off the affinity target is a
+        # fallback even when the probe matched
+        routed = "least_loaded"
+        if self.affinity:
+            if overlap > 0 and n == 0:
+                routed = "affinity"
+                self.prefix_affinity_hits += 1
+                self.engines[i]._emit(
+                    "routed_affinity", rid=local, overlap=overlap,
+                    replica=i,
+                )
+            else:
+                routed = "fallback"
+                self.routed_fallback += 1
+                self.engines[i]._emit(
+                    "routed_fallback", rid=local, replica=i,
+                )
         rid = self._next_rid
         self._next_rid += 1
         self._route[rid] = (i, local)
@@ -352,11 +530,12 @@ class ServingCluster:
         # contract; only the already-emitted progress is recomputed).
         # The ORIGINAL submit time rides along so a re-served request's
         # TTFT still measures from first submission — hiding the outage
-        # the watchdog just detected would defeat the metric.
+        # the watchdog just detected would defeat the metric. The
+        # routing decision rides too (front door/failover observability).
         self._submitted[rid] = (
             np.asarray(prompt, np.int32).reshape(-1).copy(),
             max_new_tokens, eos_id, seed, self.engines[i].clock(),
-            priority, deadline,
+            priority, deadline, routed,
         )
         return rid
 
@@ -367,6 +546,15 @@ class ServingCluster:
         True when the request was live. The submission record drops
         with the route — a cancelled request must never be re-served by
         a later cold failover."""
+        rec = self._handoff.pop(rid, None)
+        if rec is not None:
+            # caught between pools: the exported record IS the request
+            # now (the source slot already released); dropping it is
+            # the cancellation — no engine holds any state to tear down
+            rec.req.outcome = "cancelled"
+            self.cancelled[rid] = rec.req
+            self._submitted.pop(rid, None)
+            return True
         route = self._route.get(rid)
         if route is None:
             return False
@@ -418,6 +606,9 @@ class ServingCluster:
             req = d.get(rid)
             if req is not None:
                 return req
+        rec = self._handoff.get(rid)
+        if rec is not None:
+            return rec.req  # mid-handoff: live, tokens pending
         route = self._route.get(rid)
         if route is None:
             return None
@@ -504,20 +695,24 @@ class ServingCluster:
         mine = [g for g, (ri, _) in self._route.items() if ri == i]
         n_moved = len(mine) if cold else len(drained)
         self.requeued_requests += n_moved
-        alive = self._alive()
-        if not alive:
-            if self._route:
+        if not self._alive():
+            if self._route or self._handoff:
                 raise ClusterUnavailable(
                     f"replica {i} died ({self.health_reason[i]}) with "
                     f"{n_moved} requests to fail over and no survivors"
                 )
             return
+        # disaggregated: failed-over work re-enters through the prefill
+        # pool (it re-prefills — possibly via cache hits — then hands
+        # off again), keeping the pool discipline; a drained request
+        # resubmitted anywhere still yields the same stream
+        targets = self._submit_targets()
         for grid in mine:
             if cold:
-                prompt, n, eos_id, seed, t0, prio, deadline = (
+                prompt, n, eos_id, seed, t0, prio, deadline, _routed = (
                     self._submitted[grid]
                 )
-                j = self._least_loaded(alive)
+                j = self._least_loaded(targets)
                 req = self.engines[j].make_request(
                     prompt, n, eos_id=eos_id, seed=seed, priority=prio,
                     deadline=deadline,
@@ -527,11 +722,107 @@ class ServingCluster:
                 req = drained.pop(self._route[grid][1], None)
                 if req is None:
                     continue  # finished and harvested above
-                j = self._least_loaded(alive)
+                j = self._least_loaded(targets)
             self._route[grid] = (j, self.engines[j].resubmit(req))
         assert cold or not drained, (
             f"drained requests {sorted(drained)} had no cluster route"
         )
+
+    # -- the prefill -> decode handoff pump ---------------------------------
+
+    def _requeue_cold(self, grid: int) -> None:
+        """Re-serve one cluster request from scratch off its submission
+        record, onto the least-loaded submit target (prefill pool when
+        disaggregated). Same stream by the determinism contract; the
+        ORIGINAL submit time / priority / deadline ride along — this is
+        the single-request version of a cold failover, used when a
+        handoff export fails."""
+        prompt, n, eos_id, seed, t0, prio, deadline, _routed = (
+            self._submitted[grid]
+        )
+        targets = self._submit_targets()
+        if not targets:
+            raise ClusterUnavailable(
+                f"no replica alive to re-serve request {grid}"
+            )
+        j = self._least_loaded(targets)
+        req = self.engines[j].make_request(
+            prompt, n, eos_id=eos_id, seed=seed, priority=prio,
+            deadline=deadline,
+        )
+        req.submit_time = t0
+        self._route[grid] = (j, self.engines[j].resubmit(req))
+        self.requeued_requests += 1
+
+    def _pump_handoffs(self) -> None:
+        """Move every handoff-ready slot from the prefill pool to the
+        decode pool: export (pages + scale planes + carried logits row
+        leave as host arrays — the honest DCN wire model), then import
+        into the least-loaded alive decode replica. Runs at the END of
+        each scheduler round, after every replica's step has settled —
+        the pump is a cluster action on engines that are provably not
+        mid-step, the same invariant failover relies on.
+
+        A full decode pool keeps the record pending (retried next
+        round; ``has_work`` counts it). A dead decode pool degrades to
+        importing into alive prefill replicas — a prefill-role engine
+        decodes an IMPORTED slot normally (the role only parks its own
+        prefill completions), so the cluster limps instead of
+        deadlocking. A scripted export fault (:class:`HandoffFailed`)
+        abandons the source copy and re-serves COLD from the
+        submission record — bit-identical, chaos-replayed."""
+        if not self.disaggregated:
+            return
+        rev = {route: g for g, route in self._route.items()}
+        for i in self._prefill_pool:
+            if self.health[i] == "dead":
+                continue
+            eng = self.engines[i]
+            for s in eng.handoff_ready_slots():
+                req = eng.slot_req[s]
+                grid = rev.get((i, req.rid))
+                if grid is None:
+                    continue  # not cluster-routed (direct engine use)
+                t0 = eng.clock()
+                try:
+                    rec = eng.export_request(s)
+                except HandoffFailed:
+                    self.handoff_failures += 1
+                    # the export raised BEFORE any state left the slot:
+                    # abandon this copy (pages release through the
+                    # normal path — no cancel, the request is not
+                    # cancelled) and re-serve cold
+                    eng._live.pop(req.rid, None)
+                    eng._release_slot(s)
+                    del self._route[grid]
+                    self._requeue_cold(grid)
+                    continue
+                if eng.telemetry is not None:
+                    eng.telemetry.record_dispatch(
+                        "handoff", step=eng.fault_step, t=t0,
+                        dur=eng.clock() - t0, rids=(req.rid,), tokens=0,
+                        pages=rec.n_pages, bytes=rec.nbytes,
+                    )
+                del self._route[grid]
+                self._handoff[grid] = rec
+        for grid in list(self._handoff):
+            rec = self._handoff[grid]
+            targets = [
+                j for j in self._decode_pool if self.health[j] != "dead"
+            ] or [
+                j for j in self._prefill_pool if self.health[j] != "dead"
+            ]
+            for j in sorted(
+                targets, key=lambda j: (self._load(self.engines[j]), j)
+            ):
+                local = self.engines[j].import_request(rec)
+                if local is not None:
+                    self._route[grid] = (j, local)
+                    del self._handoff[grid]
+                    self.handoffs += 1
+                    self.handoff_pages_moved += rec.n_pages
+                    self.handoff_bytes += rec.nbytes
+                    break
 
     @staticmethod
     def _classify(exc: BaseException) -> tp.Tuple[str, bool]:
@@ -647,7 +938,7 @@ class ServingCluster:
         is dead with requests still pending."""
         alive = self._alive()
         if not alive:
-            if self._route:
+            if self._route or self._handoff:
                 raise ClusterUnavailable(
                     "every replica is dead with requests pending"
                 )
@@ -701,6 +992,10 @@ class ServingCluster:
                 self._recover(i)
         for i, cold in terminal:
             self._failover(i, cold=cold)
+        # handoffs pump AFTER failures settle: every engine touched is
+        # provably not mid-step, and a slot that went handoff-ready
+        # this round reaches its decode replica before the next one
+        self._pump_handoffs()
         self._harvest()
         return progressed
 
@@ -739,10 +1034,18 @@ class ServingCluster:
             else:
                 agg[k] = sum(s[k] for s in per)
         agg["dp_replicas"] = len(per)
+        agg["prefill_replicas"] = self.prefill_replicas
+        agg["decode_replicas"] = self.decode_replicas
         agg["watchdog_trips"] = self.watchdog_trips
         agg["retries"] = self.retries
         agg["failovers"] = self.failovers
         agg["requeued_requests"] = self.requeued_requests
+        agg["handoffs"] = self.handoffs
+        agg["handoff_pages_moved"] = self.handoff_pages_moved
+        agg["handoff_bytes"] = self.handoff_bytes
+        agg["handoff_failures"] = self.handoff_failures
+        agg["prefix_affinity_hits"] = self.prefix_affinity_hits
+        agg["routed_fallback"] = self.routed_fallback
         agg["dead_replicas"] = self.health.count("dead")
         agg["replica_health"] = list(self.health)
         agg["replica_health_reason"] = list(self.health_reason)
@@ -765,10 +1068,18 @@ class ServingCluster:
         return {
             "cluster": {
                 "dp_replicas": len(self.engines),
+                "prefill_replicas": self.prefill_replicas,
+                "decode_replicas": self.decode_replicas,
                 "watchdog_trips": self.watchdog_trips,
                 "retries": self.retries,
                 "failovers": self.failovers,
                 "requeued_requests": self.requeued_requests,
+                "handoffs": self.handoffs,
+                "handoff_pages_moved": self.handoff_pages_moved,
+                "handoff_bytes": self.handoff_bytes,
+                "handoff_failures": self.handoff_failures,
+                "prefix_affinity_hits": self.prefix_affinity_hits,
+                "routed_fallback": self.routed_fallback,
                 "dead_replicas": self.health.count("dead"),
                 "replica_health": list(self.health),
                 "replica_health_reason": list(self.health_reason),

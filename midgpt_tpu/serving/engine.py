@@ -100,7 +100,11 @@ from midgpt_tpu.models.gpt import (
     prefill_chunk_paged,
     verify_tokens_paged,
 )
-from midgpt_tpu.serving.faults import AdmissionRejected, PoolOverloaded
+from midgpt_tpu.serving.faults import (
+    AdmissionRejected,
+    HandoffFailed,
+    PoolOverloaded,
+)
 from midgpt_tpu.serving.speculate import NgramProposer, Proposer
 from midgpt_tpu.serving.telemetry import (
     EngineTelemetry,
@@ -112,7 +116,9 @@ from midgpt_tpu.serving.paged import (
     PagedKVPool,
     PrefixIndex,
     copy_page,
+    export_pages,
     flush_recent,
+    import_pages,
     pages_needed,
     write_token_rows,
 )
@@ -940,6 +946,40 @@ class Request:
         return self.finish_time is not None
 
 
+@dataclasses.dataclass
+class HandoffRecord:
+    """A fully-prefilled request packaged for the prefill→decode page
+    handoff (serving.cluster disaggregated pools): the live
+    :class:`Request`, its context tokens, the block-table-addressed page
+    payloads (+ int8 scale planes) as host arrays, and the CARRIED
+    LOGITS ROW — exactly the row the final prefill chunk wrote, which is
+    what a monolithic engine would decode its first token from, so the
+    importing decode engine resumes the stream bit-identically. Built by
+    :meth:`ServingEngine.export_request`, consumed by
+    :meth:`ServingEngine.import_request`; everything here is host state,
+    so the record crosses engines (and, in a multi-host deployment, the
+    DCN wire) with no device aliasing."""
+
+    req: Request
+    ctx: tp.List[int]  # the slot's context tokens (== the prompt)
+    resident: int  # pool-resident tokens (== len(ctx))
+    logits_row: np.ndarray  # [V] f32 — the final prefill chunk's row
+    n_pages: int
+    k: np.ndarray  # [L, n_pages, Hkv, C, PS] pool dtype
+    v: np.ndarray
+    sk: tp.Optional[np.ndarray]  # [L, n_pages, Hkv] f32 (int8 pools)
+    sv: tp.Optional[np.ndarray]
+
+    @property
+    def nbytes(self) -> int:
+        """Handoff wire bytes (payload + scales + logits) — what
+        ``serve_handoff_bytes`` accounts."""
+        n = self.k.nbytes + self.v.nbytes + self.logits_row.nbytes
+        if self.sk is not None:
+            n += self.sk.nbytes + self.sv.nbytes
+        return int(n)
+
+
 # Registry-backed counter attributes of ServingEngine: every name here
 # becomes a class-level property reading/writing the engine's
 # MetricsRegistry Counter of the same name (attached right after the
@@ -1071,8 +1111,19 @@ class ServingEngine:
         priority_aging: float = 0.125,
         fault_hook: tp.Optional[tp.Callable[["ServingEngine"], None]] = None,
         telemetry: tp.Union[None, bool, EngineTelemetry] = None,
+        role: str = "both",
     ):
         assert slots >= 1 and window >= 1 and page_size >= 1
+        # replica class (serving.cluster disaggregated pools): "both" is
+        # the monolithic engine; "prefill" runs chunked prefill to
+        # completion and then PARKS the slot handoff-ready (it never
+        # decodes — the cluster exports the pages to a decode-class
+        # engine); "decode" is a routing label only — the engine is a
+        # full engine (eviction re-queues must re-prefill locally, which
+        # is what keeps post-handoff eviction bit-identical), the
+        # cluster just never routes fresh submissions at it.
+        assert role in ("both", "prefill", "decode"), role
+        self.role = role
         # observability (serving.telemetry): the metrics registry is
         # ALWAYS on — the counter attributes below are properties over
         # it, so stats() is a façade over one source of truth — while
@@ -1325,6 +1376,13 @@ class ServingEngine:
         self.pooled_len = np.zeros((slots,), np.int32)
         self.done = np.ones((slots,), bool)  # empty slots ride as done
         self.prefilling = np.zeros((slots,), bool)
+        # prefill-role engines: slot fully prefilled, parked awaiting the
+        # cluster's page export (done stays True — no decode window ever
+        # carries a handoff-ready slot)
+        self.handoff_ready = np.zeros((slots,), bool)
+        # scripted `handoff` fault (serving.faults): armed by the hook,
+        # fires inside the next export_request
+        self._handoff_poison = False
         self.emitted = np.zeros((slots,), np.int32)
         self.budget = np.zeros((slots,), np.int32)
         self.eos = np.full((slots,), -1, np.int32)
@@ -1628,6 +1686,136 @@ class ServingEngine:
         self._live.clear()  # every live request just left this engine
         return out
 
+    # -- page handoff (the disaggregated cluster's seam) --------------------
+
+    def handoff_ready_slots(self) -> tp.List[int]:
+        """Slots whose prompt is fully prefilled and parked for export
+        (prefill-role engines only; always empty elsewhere)."""
+        return [s for s in range(self.slots) if self.handoff_ready[s]]
+
+    def export_request(self, s: int) -> HandoffRecord:
+        """Package handoff-ready slot ``s`` for a decode-class engine:
+        page payloads (+ int8 scale planes) and the carried logits row
+        leave as host arrays, then the slot releases through the normal
+        path — indexed pages retire COLD, so this prefill replica's
+        prefix cache keeps serving hits on the exported chain (that is
+        what makes prefix-affinity routing to prefill replicas pay).
+
+        Raises :class:`HandoffFailed` when a scripted ``handoff`` fault
+        is armed — BEFORE any state leaves the slot, so the request is
+        still intact here and the cluster can abandon this copy and
+        re-serve cold from its submission record (streams bit-identical
+        by the determinism contract)."""
+        req = self.slot_req[s]
+        assert req is not None and bool(self.handoff_ready[s]), (s, req)
+        if self._handoff_poison:
+            self._handoff_poison = False
+            raise HandoffFailed(
+                f"scripted handoff fault exporting rid {req.rid} "
+                f"(slot {s})"
+            )
+        p = int(self.pooled_len[s])
+        n_pages = pages_needed(p, self.page_size)
+        ids = [int(x) for x in self.bt[s, :n_pages]]
+        k, v, sk, sv = export_pages(self.pool, ids)
+        rec = HandoffRecord(
+            req=req,
+            ctx=list(self.slot_ctx[s]),
+            resident=p,
+            # the final prefill chunk wrote exactly the logits a
+            # monolithic prefill would leave; carrying this row is what
+            # makes the first decoded token bit-identical
+            logits_row=np.asarray(self.logits[s], np.float32),
+            n_pages=n_pages,
+            k=k, v=v, sk=sk, sv=sv,
+        )
+        self._emit(
+            "handoff", rid=req.rid, slot=s, direction="export",
+            pages=n_pages,
+        )
+        self._live.pop(req.rid, None)
+        self._release_slot(s)
+        return rec
+
+    def import_request(self, rec: HandoffRecord) -> tp.Optional[int]:
+        """Land a :class:`HandoffRecord` in a free slot of THIS engine:
+        alias whatever full-page prefix this pool's index already holds
+        (same match-pin discipline as admission — capped at the last
+        prompt token, so the append page is always private), import the
+        remaining pages' payloads byte-exactly, point the block table at
+        them, set the carried logits row, and re-register the chain in
+        this pool's prefix index so the handed-off prefix serves future
+        hits here too. Returns the fresh engine-local rid, or None when
+        no slot or no page capacity is available right now (the cluster
+        keeps the record and retries next step).
+
+        The slot resumes decoding exactly where a local prefill would
+        have left it (same pooled_len, same logits row, same request
+        seed), so the stream is bit-identical to the monolithic engine —
+        and a later eviction under pressure re-prefills locally through
+        the ordinary (also bit-identical) eviction path."""
+        free = [s for s in range(self.slots) if self.slot_req[s] is None]
+        if not free:
+            return None
+        s = free[0]
+        ctx = rec.ctx
+        p = rec.resident
+        assert p == len(ctx) and rec.n_pages == pages_needed(
+            p, self.page_size
+        ), (p, len(ctx), rec.n_pages)
+        req = rec.req
+        full: tp.List[int] = []
+        if self.index is not None:
+            full, _, _ = self.index.match(ctx[: p - 1])
+        for pg in full:
+            self.alloc.incref(pg)
+            self.index.revive(pg)
+        need = rec.n_pages - len(full)
+        if not self._try_reserve(need):
+            self._release_pages(full)
+            return None
+        fresh = self.alloc.alloc(need)
+        pages = full + fresh
+        # payload lands only on the non-aliased pages (the exported
+        # stack is block-table-ordered, so the aliased prefix occupies
+        # positions 0..len(full)-1 and already holds identical bytes by
+        # the content-chain contract)
+        self.pool = import_pages(
+            self.pool, fresh,
+            rec.k[:, len(full):], rec.v[:, len(full):],
+            None if rec.sk is None else rec.sk[:, len(full):],
+            None if rec.sv is None else rec.sv[:, len(full):],
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        req.rid = rid
+        self._live[rid] = req
+        self.slot_req[s] = req
+        self.slot_pages[s] = list(pages)
+        self.slot_pins[s] = []
+        self.bt[s, :] = self._sentinel
+        self.bt[s, : rec.n_pages] = pages
+        self.pooled_len[s] = p
+        self.done[s] = False
+        self.prefilling[s] = False
+        self.handoff_ready[s] = False
+        self.emitted[s] = len(req.tokens)
+        self.budget[s] = req.max_new_tokens
+        self.eos[s] = req.eos_id
+        self.seeds[s] = req.seed
+        self.slot_ctx[s] = [int(t) for t in ctx]
+        self.slot_registered[s] = len(full)
+        self.slot_node[s] = full[-1] if full else PrefixIndex._ROOT
+        self.logits = self.logits.at[s].set(
+            jnp.asarray(rec.logits_row, jnp.float32)
+        )
+        self._register_pages(s)
+        self._emit(
+            "handoff", rid=rid, slot=s, direction="import",
+            pages=rec.n_pages, aliased=len(full), imported=need,
+        )
+        return rid
+
     # -- cancellation + lookup (the front door's seams) ---------------------
 
     def cancel(self, rid: int) -> bool:
@@ -1729,7 +1917,9 @@ class ServingEngine:
         return [
             s
             for s in range(self.slots)
-            if self.slot_req[s] is not None and not self.prefilling[s]
+            if self.slot_req[s] is not None
+            and not self.prefilling[s]
+            and not self.handoff_ready[s]
         ]
 
     def _prefill_bucket(self, p: int) -> int:
@@ -1950,7 +2140,14 @@ class ServingEngine:
         self._register_pages(s)
         if start + clen >= p:
             self.prefilling[s] = False
-            self.done[s] = False  # decodable from the next window on
+            if self.role == "prefill":
+                # disaggregated pools: the slot parks fully-prefilled
+                # (done stays True, so no decode window ever carries
+                # it) until the cluster exports its pages to a
+                # decode-class engine
+                self.handoff_ready[s] = True
+            else:
+                self.done[s] = False  # decodable from the next window on
             return True
         return False
 
@@ -2024,6 +2221,7 @@ class ServingEngine:
         self.pooled_len[s] = 0
         self.done[s] = True
         self.prefilling[s] = False
+        self.handoff_ready[s] = False
         self.slot_ctx[s] = []
         self.slot_registered[s] = 0
         self.slot_node[s] = PrefixIndex._ROOT

@@ -53,6 +53,12 @@ Event kinds:
                   (-1 = all) for ``hold_steps`` scheduler steps —
                   drives the engine's overload paths (eviction,
                   parking) without any device-side fault at all.
+- ``handoff``   — poison the NEXT page handoff off this replica
+                  (:class:`HandoffFailed` at export time, not at the
+                  step top): the disaggregated cluster's prefill→decode
+                  page move fails mid-flight and the request re-serves
+                  cold from the submission record. Armed at the step
+                  the event names; fires when the cluster next exports.
 
 Compact spec grammar (the ``--fault_plan`` CLI flag)::
 
@@ -62,6 +68,7 @@ Compact spec grammar (the ``--fault_plan`` CLI flag)::
     "4:wedge@0:0.5"        replica 0 stalls 0.5 s, watchdog territory
     "3:transient"          replica 0, one retriable failure at step 3
     "2:exhaust@0:all:3"    quarantine all free pages for 3 steps
+    "2:handoff@0"          replica 0's next page export fails
 """
 
 from __future__ import annotations
@@ -77,6 +84,7 @@ __all__ = [
     "DeadlineExceeded",
     "FaultEvent",
     "FaultPlan",
+    "HandoffFailed",
     "PoolOverloaded",
     "ReplicaCrash",
     "ServingFault",
@@ -165,12 +173,23 @@ class DeadlineExceeded(ServingFault):
         )
 
 
+class HandoffFailed(ServingFault):
+    """A prefill→decode page handoff failed mid-flight (the replica
+    crashed or the page move was poisoned by a scripted ``handoff``
+    fault) BEFORE the exported state left the source engine. The slot
+    is still intact on the prefill replica; the cluster abandons that
+    copy and re-serves the request COLD from its submission record —
+    the same stream by the determinism contract. Never surfaces to a
+    submitter: it is a cluster-internal failover trigger, counted in
+    ``handoff_failures``."""
+
+
 class ClusterUnavailable(ServingFault):
     """Every replica is dead and requests are still pending — the one
     failure the cluster cannot degrade through."""
 
 
-_KINDS = ("crash", "wedge", "transient", "exhaust")
+_KINDS = ("crash", "wedge", "transient", "exhaust", "handoff")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,6 +332,11 @@ class _EngineFaultHook:
                     due if self._release_at is None
                     else max(self._release_at, due)
                 )
+            elif ev.kind == "handoff":
+                # armed, not raised: the fault fires inside the NEXT
+                # export_request off this engine (the page move is a
+                # cluster action, not a step-top dispatch)
+                engine._handoff_poison = True
             elif ev.kind == "crash":
                 raise ReplicaCrash(f"scripted crash at step {step}")
             elif ev.kind == "transient":

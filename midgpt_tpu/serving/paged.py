@@ -31,6 +31,7 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from midgpt_tpu.config import ModelConfig
 from midgpt_tpu.parallel.sharding import shard_act
@@ -763,6 +764,83 @@ def copy_page(pool: PagedKVPool, src: Array, dst: Array) -> PagedKVPool:
     return PagedKVPool(
         k=jax.lax.dynamic_update_slice_in_dim(pool.k, k_row, dst, axis=1),
         v=jax.lax.dynamic_update_slice_in_dim(pool.v, v_row, dst, axis=1),
+        page_size=pool.page_size,
+        scale_k=scale_k,
+        scale_v=scale_v,
+    )
+
+
+def export_pages(
+    pool: PagedKVPool, page_ids: tp.Sequence[int]
+) -> tp.Tuple:
+    """Pull ``n`` pages' K/V payloads (plus int8 scale planes) out of the
+    pool as HOST arrays — the disaggregated cluster's page-handoff wire
+    format (serving.cluster): a prefill-class engine exports a finished
+    prompt's block-table-addressed pages, and a decode-class engine
+    :func:`import_pages` them into ITS pool under freshly allocated ids.
+
+    Returns ``(k, v, sk, sv)`` with ``k``/``v`` shaped
+    ``[L, n, Hkv, C, PS]`` in the pool dtype (bf16 survives the numpy
+    round-trip via ml_dtypes) and ``sk``/``sv`` the ``[L, n, Hkv]`` f32
+    scale planes, or None for float pools. Payload and scales travel
+    TOGETHER — a page and its scale are one atomic unit (copy_page's
+    contract), and splitting them across the handoff would decode the
+    moved prefix under a stale scale on the far side.
+
+    Host round-trip on purpose: replica pools live on disjoint
+    devices/meshes, so a device-to-device alias cannot cross them, and
+    the numpy hop is the honest model of the DCN wire a multi-host
+    deployment pays. Pages are COPIED, not moved — the source engine
+    releases its ids through the normal cold-retire path afterwards, so
+    its prefix cache keeps serving hits on the exported chain."""
+    ids = jnp.asarray(list(page_ids), jnp.int32)
+    # take() along the replicated page dim is shard-local under TP —
+    # each shard gathers its own heads — and np.asarray gathers the
+    # full [L, n, Hkv, C, PS] host copy across shards
+    k = np.asarray(jnp.take(pool.k, ids, axis=1))
+    v = np.asarray(jnp.take(pool.v, ids, axis=1))
+    sk = sv = None
+    if pool.quantized:
+        sk = np.asarray(jnp.take(pool.scale_k, ids, axis=1))
+        sv = np.asarray(jnp.take(pool.scale_v, ids, axis=1))
+    return k, v, sk, sv
+
+
+def import_pages(
+    pool: PagedKVPool,
+    page_ids: tp.Sequence[int],
+    k,
+    v,
+    sk=None,
+    sv=None,
+) -> PagedKVPool:
+    """Write :func:`export_pages` payloads into ``pool`` at
+    ``page_ids`` — the receiving half of the page handoff. Payload and
+    scale planes land in one logical update (both or neither), the
+    byte-exact inverse of the export: no arithmetic touches the values,
+    so the imported pages read back bit-identically to the source pool
+    (the disaggregated bit-identity gate rests on this).
+
+    Runs eagerly (a handoff is once per request, not per dispatch);
+    under TP the page dim is replicated and the head dim sharded, so
+    the scatter is shard-local and GSPMD propagation keeps the pool's
+    committed sharding, exactly like :func:`copy_page`."""
+    n = len(list(page_ids))
+    assert k.shape[1] == n and v.shape[1] == n, (k.shape, n)
+    ids = jnp.asarray(list(page_ids), jnp.int32)
+    new_k = pool.k.at[:, ids].set(jnp.asarray(k, pool.k.dtype))
+    new_v = pool.v.at[:, ids].set(jnp.asarray(v, pool.v.dtype))
+    scale_k, scale_v = pool.scale_k, pool.scale_v
+    if pool.quantized:
+        assert sk is not None and sv is not None, (
+            "int8 pool import needs the exported scale planes — payload "
+            "and scale are one atomic unit"
+        )
+        scale_k = scale_k.at[:, ids].set(jnp.asarray(sk, jnp.float32))
+        scale_v = scale_v.at[:, ids].set(jnp.asarray(sv, jnp.float32))
+    return PagedKVPool(
+        k=new_k,
+        v=new_v,
         page_size=pool.page_size,
         scale_k=scale_k,
         scale_v=scale_v,

@@ -148,10 +148,18 @@ ENGINE_STATS_KEYS: tp.Tuple[str, ...] = (
 #: cluster-level keys (aggregation: sums, except the documented means).
 CLUSTER_STATS_KEYS: tp.Tuple[str, ...] = ENGINE_STATS_KEYS + (
     "dp_replicas",
+    "prefill_replicas",
+    "decode_replicas",
     "watchdog_trips",
     "retries",
     "failovers",
     "requeued_requests",
+    "handoffs",
+    "handoff_pages_moved",
+    "handoff_bytes",
+    "handoff_failures",
+    "prefix_affinity_hits",
+    "routed_fallback",
     "dead_replicas",
     "replica_health",
     "replica_health_reason",
@@ -174,7 +182,12 @@ CLUSTER_STATS_KEYS: tp.Tuple[str, ...] = ENGINE_STATS_KEYS + (
 #: injection firing; ``cancelled`` = the submitter tore the request
 #: down (slot reclaimed, pages released — serving.frontdoor);
 #: ``deadline_shed`` = the scheduler dropped a queued/parked request
-#: whose deadline passed before dispatch (the pre-dispatch SLO shed).
+#: whose deadline passed before dispatch (the pre-dispatch SLO shed);
+#: ``handoff`` = a prefill→decode page move (direction="export" on the
+#: source engine, "import" on the destination — disaggregated pools);
+#: ``routed_affinity`` / ``routed_fallback`` = the cluster's admission
+#: decision (prefix-affinity hit vs least-loaded fallback), emitted on
+#: the chosen replica's telemetry.
 EVENT_KINDS: tp.Tuple[str, ...] = (
     "submit",
     "queued",
@@ -192,6 +205,9 @@ EVENT_KINDS: tp.Tuple[str, ...] = (
     "fault",
     "cancelled",
     "deadline_shed",
+    "handoff",
+    "routed_affinity",
+    "routed_fallback",
 )
 
 
@@ -421,7 +437,10 @@ def chrome_trace(tele: EngineTelemetry) -> tp.Dict[str, tp.Any]:
                 "args": dict(ev.data, step=ev.step),
             })
 
-    lanes = {"decode_window": 0, "verify_dispatch": 1, "prefill_chunk": 2}
+    lanes = {
+        "decode_window": 0, "verify_dispatch": 1, "prefill_chunk": 2,
+        "handoff": 3,
+    }
     for kind, tid in lanes.items():
         events.append({
             "ph": "M", "pid": _DISPATCH_PID, "tid": tid,
@@ -432,7 +451,7 @@ def chrome_trace(tele: EngineTelemetry) -> tp.Dict[str, tp.Any]:
             "name": d.kind,
             "ph": "X",
             "pid": _DISPATCH_PID,
-            "tid": lanes.get(d.kind, 3),
+            "tid": lanes.get(d.kind, 4),
             "ts": (d.t - base) * 1e6,
             "dur": max(0.0, d.dur) * 1e6,
             "args": dict(d.data, step=d.step, tokens=d.tokens,

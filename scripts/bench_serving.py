@@ -213,6 +213,22 @@ def main() -> None:
                     "(midgpt_tpu.serving.ServingCluster); each replica "
                     "owns tp devices, its own page pool and prefix "
                     "cache — throughput scales, nothing is shared")
+    ap.add_argument("--disagg", default=None, metavar="P+D",
+                    help="disaggregated prefill/decode pools: 'P+D' runs "
+                    "P prefill-class replicas (chunked prefill to "
+                    "completion, then page handoff) and D decode-class "
+                    "replicas (midgpt_tpu.serving.ServingCluster("
+                    "prefill_replicas=, decode_replicas=)); streams stay "
+                    "bit-identical to the monolithic engine, the record "
+                    "gains handoff counters and a per-class TTFT split. "
+                    "Mutually exclusive with --dp_replicas > 1")
+    ap.add_argument("--affinity", choices=("on", "off"), default="off",
+                    help="prefix-affinity admission: route each request "
+                    "to the replica whose resident prefix cache overlaps "
+                    "its prompt longest (load-imbalance capped, "
+                    "least-loaded fallback) — the zipf --tenants trace "
+                    "is the workload where this strictly beats blind "
+                    "least-loaded on serve_prefix_hit_rate")
     ap.add_argument("--fault_plan", default=None,
                     help="scripted chaos (serving.faults spec grammar, "
                     "e.g. '2:transient@0;4:crash@0'): deterministic "
@@ -327,6 +343,8 @@ def main() -> None:
         f" quant={args.quant} kv_quant={args.kv_quant}"
         f" kernel={args.paged_kernel} ls={args.layer_scan}"
         f" tp={args.tp} dp={args.dp_replicas}"
+        f"{f' disagg={args.disagg}' if args.disagg else ''}"
+        f"{' affinity' if args.affinity == 'on' else ''}"
         f"{' faults=' + args.fault_plan if args.fault_plan else ''}"
         f"{' trace=' + args.trace if args.trace != 'off' else ''}"
         f"{f' slo={args.slo_ms:g}ms' if args.slo_ms else ''}"
@@ -561,19 +579,40 @@ def main() -> None:
         # — the engines still hit the same program cache entries)
         telemetry=args.telemetry == "on",
     )
-    meshes = serving_meshes(tp_size=args.tp, dp_replicas=args.dp_replicas)
+    # disaggregated pools: '--disagg P+D' replaces the homogeneous
+    # --dp_replicas fleet with P prefill-class + D decode-class replicas
+    disagg_p = disagg_d = 0
+    if args.disagg:
+        assert args.dp_replicas == 1, (
+            "--disagg P+D and --dp_replicas are mutually exclusive "
+            "(disagg fixes the replica count at P+D)"
+        )
+        parts = args.disagg.split("+")
+        assert len(parts) == 2, f"--disagg wants 'P+D', got {args.disagg!r}"
+        disagg_p, disagg_d = int(parts[0]), int(parts[1])
+    n_replicas = (disagg_p + disagg_d) if args.disagg else args.dp_replicas
+    if args.disagg and args.tp == 1 and jax.device_count() < n_replicas:
+        # scheduler-correctness mode (the replicas=N documented shape):
+        # all pools on the default device — CPU drives of the disagg
+        # seam without forcing a host device count
+        meshes = [None] * n_replicas
+    else:
+        meshes = serving_meshes(tp_size=args.tp, dp_replicas=n_replicas)
     # fault injection and the dispatch watchdog live in the cluster's
     # health/failover layer, so chaos runs always drive a cluster (a
     # 1-replica cluster is the degenerate case: faults still degrade
     # into typed outcomes instead of crashing the bench)
     use_cluster = (
-        args.dp_replicas > 1
+        n_replicas > 1
         or plan is not None
         or args.dispatch_timeout_s is not None
     )
     if use_cluster:
         eng = ServingCluster(
             model, meshes=meshes, fault_plan=plan,
+            prefill_replicas=disagg_p or None,
+            decode_replicas=disagg_d or None,
+            affinity=args.affinity == "on",
             dispatch_timeout_s=args.dispatch_timeout_s,
             max_retries=args.max_retries, backoff_s=args.backoff_s,
             # dead-replica flight recorders (crash / watchdog trip /
@@ -608,7 +647,16 @@ def main() -> None:
     for e in engines:
         e._fault_hook = None  # chaos must not fire inside warmup
         e.submit(prompts[0], int(nnews[0]))
-        e.run()
+        if e.role == "prefill":
+            # a prefill-class replica never decodes: step to the
+            # handoff-ready park (compiling every prefill bucket the
+            # trace needs), then export-and-discard to clear the slot
+            while e.has_work and not e.handoff_ready_slots():
+                e.step()
+            for s in e.handoff_ready_slots():
+                e.export_request(s)
+        else:
+            e.run()
         e.warm_prefill(max(p.size for p in prompts))
         e.finished.clear()
         e.clear_prefix_cache()  # measured hit rates: the trace alone
@@ -629,6 +677,7 @@ def main() -> None:
     if use_cluster:
         eng.finished.clear()
         eng._route.clear()
+        eng._handoff.clear()
     if plan is not None:
         # re-arm FRESH hooks with step counters at zero: the scripted
         # plan is keyed to the measured trace's scheduler steps, not the
@@ -842,6 +891,33 @@ def main() -> None:
         (lambda q: round(ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))], 1))
         if ttfts else (lambda q: None)
     )
+    # --disagg: TTFT split by the replica class that FINISHED each
+    # request (decode-class replicas own every post-handoff first token;
+    # prefill-class entries are non-empty only in degraded operation).
+    # The engine-level finished dicts survive cluster harvest, so the
+    # split reads them directly.
+    ttft_by_class = None
+    if args.disagg:
+        ttft_by_class = {}
+        for cls in ("prefill", "decode"):
+            vals = sorted(
+                (r.first_token_time - r.submit_time) * 1e3
+                for e in engines if e.role == cls
+                for r in e.finished.values()
+                if r.first_token_time is not None
+            )
+            ttft_by_class[cls] = {
+                "n": len(vals),
+                "p50_ms": (
+                    round(vals[min(len(vals) - 1, len(vals) // 2)], 1)
+                    if vals else None
+                ),
+                "p99_ms": (
+                    round(vals[min(len(vals) - 1,
+                                   int(0.99 * len(vals)))], 1)
+                    if vals else None
+                ),
+            }
     st = eng.stats()
 
     # measured-vs-floor attainment + serving MFU (the r6 rungs land
@@ -858,7 +934,7 @@ def main() -> None:
         wall * 1e3 / st["tokens_generated"]
         if st["tokens_generated"] else None
     )
-    n_chips = max(1, args.tp * args.dp_replicas)
+    n_chips = max(1, args.tp * n_replicas)
     serve_mfu_v = (
         round(
             (st["tokens_generated"] / wall)
@@ -1028,6 +1104,16 @@ def main() -> None:
         "serve_tok_s": round(st["tokens_generated"] / wall, 1),
         "serve_ttft_p50_ms": pct(0.50),
         "serve_ttft_p99_ms": pct(0.99),
+        # disaggregated pools + affinity routing (serving.cluster)
+        "serve_disagg": args.disagg,
+        "serve_affinity": args.affinity,
+        "serve_ttft_by_class": ttft_by_class,
+        "serve_handoff_count": st.get("handoffs", 0),
+        "serve_handoff_pages": st.get("handoff_pages_moved", 0),
+        "serve_handoff_bytes": st.get("handoff_bytes", 0),
+        "serve_handoff_failures": st.get("handoff_failures", 0),
+        "serve_prefix_affinity_hits": st.get("prefix_affinity_hits", 0),
+        "serve_routed_fallback": st.get("routed_fallback", 0),
         # telemetry-derived (serving.telemetry; null with --telemetry
         # off): time-between-tokens at the harvest cadence and
         # submit->first-admission queue delay

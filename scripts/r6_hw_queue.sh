@@ -201,6 +201,37 @@ run serving_slo_trace python scripts/bench_serving.py --platform=tpu \
   --tenants 4 --sys_prompt_len 128 --max_prompt 128 \
   --timeline_dir artifacts/r6/tl_slo_trace \
   --out artifacts/bench_serving_slo_trace.json
+# NEW in PR 18: disaggregated prefill/decode + prefix-affinity routing
+# (serving.cluster). Rung pair 1 — the affinity A/B on the zipf-tenant
+# shared-prefix trace: identical seed-pinned workload over 2 replicas,
+# routing off vs on. Headline delta is serve_prefix_hit_rate (affinity
+# must land strictly higher at equal serve_tokens_generated — routing
+# never changes tokens), with serve_prefix_affinity_hits /
+# serve_routed_fallback explaining the admission mix. Trace-mode
+# arrivals interleave with scheduler steps, so the router probes LIVE
+# resident state (an open-loop submit-everything drive would see empty
+# caches and fall back on every request).
+run serving_affinity_off python scripts/bench_serving.py --platform=tpu \
+  --dp_replicas 2 --trace poisson --tenants 4 --sys_prompt_len 128 \
+  --max_prompt 128 --affinity off \
+  --out artifacts/bench_serving_affinity_off.json
+run serving_affinity_on python scripts/bench_serving.py --platform=tpu \
+  --dp_replicas 2 --trace poisson --tenants 4 --sys_prompt_len 128 \
+  --max_prompt 128 --affinity on \
+  --out artifacts/bench_serving_affinity_on.json
+# Rung pair 2 — disagg 2+2 vs the chip-equal monolithic baseline (4
+# single-chip replicas either way): the row's headline is
+# serve_ttft_by_class — the compute-bound prefill pool's TTFT
+# distribution vs the dp=4 row's mixed one (PERF.md predicts the win
+# from the prefill-vs-decode roofline split) — next to
+# serve_handoff_count/bytes pricing the page movement, with the
+# timeline showing handoff spans on the prefill replicas' lanes.
+run serving_disagg_2p2 python scripts/bench_serving.py --platform=tpu \
+  --disagg 2+2 --timeline_dir artifacts/r6/tl_disagg \
+  --out artifacts/bench_serving_disagg_2p2.json
+run serving_mono_dp4 python scripts/bench_serving.py --platform=tpu \
+  --dp_replicas 4 \
+  --out artifacts/bench_serving_mono_dp4.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -420,6 +420,70 @@ def test_all_replicas_dead_raises_cluster_unavailable(model, cluster_case):
 
 
 # ---------------------------------------------------------------------------
+# Disaggregated handoff faults (prefill -> decode page handoff)
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_fault_reserves_cold_bit_identical(model, cluster_case):
+    """A scripted ``handoff`` fault poisons the next export on the
+    prefill replica: HandoffFailed fires BEFORE any state leaves the
+    slot, the cluster abandons that copy and re-serves the request cold
+    from its submission record — streams bit-identical, and the replica
+    stays healthy (a dropped handoff is not a crash)."""
+    prompts, kw, refs = cluster_case
+    cl, got = _chaos_run(
+        model, prompts, kw, FaultPlan.parse("2:handoff@0"),
+        prefill_replicas=1, decode_replicas=1,
+    )
+    assert got == refs
+    assert cl.health == ["healthy", "healthy"]
+    st = cl.stats()
+    assert st["handoff_failures"] == 1
+    assert st["requeued_requests"] >= 1
+    # the failed export never counted; the cold re-serve hands off fine
+    assert st["handoffs"] == len(prompts)
+    assert st["faults_injected"] == 1
+
+
+def test_prefill_replica_crash_mid_disagg_failover(model, cluster_case):
+    """A prefill-pool replica crashes with requests in flight: its
+    backlog re-serves cold on the SURVIVING prefill replica (submission
+    targets stay inside the prefill pool) and every stream is
+    bit-identical — handoff adds no new failover state, and requests
+    already imported into the decode pool are untouched."""
+    prompts, kw, refs = cluster_case
+    # step 1: the crash fires before the first handoff pump, so the
+    # replica still owns its share of the backlog when it dies
+    cl, got = _chaos_run(
+        model, prompts, kw, FaultPlan.parse("1:crash@0"),
+        prefill_replicas=2, decode_replicas=1,
+    )
+    assert got == refs
+    assert cl.health == ["dead", "healthy", "healthy"]
+    st = cl.stats()
+    assert st["failovers"] == 1 and st["requeued_requests"] >= 1
+    assert st["handoffs"] == len(prompts)
+    assert st["handoff_failures"] == 0
+
+
+def test_prefill_pool_death_degrades_to_decode_pool(model, cluster_case):
+    """The ENTIRE prefill pool dies: submission targets degrade to the
+    surviving decode pool — a decode-class engine is a full engine, so
+    the re-served requests prefill and decode locally (no handoff) and
+    the streams still match the monolithic reference."""
+    prompts, kw, refs = cluster_case
+    cl, got = _chaos_run(
+        model, prompts, kw, FaultPlan.parse("2:crash@0"),
+        prefill_replicas=1, decode_replicas=1,
+    )
+    assert got == refs
+    assert cl.health == ["dead", "healthy"]
+    st = cl.stats()
+    assert st["failovers"] == 1
+    assert st["handoff_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
 # The chaos acceptance matrix
 # ---------------------------------------------------------------------------
 

@@ -162,7 +162,34 @@ def test_cluster_stats_key_contract_and_aggregation(model):
     assert st["tokens_generated"] == sum(
         len(cl.finished[r].tokens) for r in rids
     )
+    # a non-disaggregated cluster reports the disagg counters as flat
+    # zeros — the keys are pinned either way
+    assert st["prefill_replicas"] == 0 and st["decode_replicas"] == 0
+    for k in ("handoffs", "handoff_pages_moved", "handoff_bytes",
+              "handoff_failures", "prefix_affinity_hits",
+              "routed_fallback"):
+        assert st[k] == 0, k
     json.dumps(cl.metrics_snapshot())
+
+
+def test_disagg_cluster_stats_same_contract(model):
+    """A disaggregated cluster answers the SAME pinned key tuple — the
+    pool split changes counter values, never the stats façade."""
+    cl = ServingCluster(
+        model, prefill_replicas=1, decode_replicas=1, **_KW
+    )
+    prompts = _prompts(3)
+    [cl.submit(p, 6, seed=i) for i, p in enumerate(prompts)]
+    cl.run()
+    st = cl.stats()
+    assert tuple(st.keys()) == CLUSTER_STATS_KEYS
+    assert st["prefill_replicas"] == 1 and st["decode_replicas"] == 1
+    assert st["handoffs"] == len(prompts)
+    assert st["handoff_pages_moved"] > 0 and st["handoff_bytes"] > 0
+    snap = cl.metrics_snapshot()
+    assert snap["cluster"]["handoffs"] == st["handoffs"]
+    assert snap["cluster"]["handoff_bytes"] == st["handoff_bytes"]
+    json.dumps(snap)
 
 
 def test_counter_attributes_are_registry_backed(model):
@@ -418,6 +445,34 @@ def test_chrome_trace_structure(model):
     }
 
 
+def test_chrome_trace_handoff_spans(model):
+    """Page handoffs render as X-phase spans on the prefill replica's
+    dispatch lane (their own tid), carrying page/byte args — and the
+    decode replica's lane shows decode windows only: the class split is
+    visible straight off the timeline."""
+    cl = ServingCluster(
+        model, prefill_replicas=1, decode_replicas=1, telemetry=True,
+        **_KW,
+    )
+    prompts = _prompts(3)
+    [cl.submit(p, 6, seed=i) for i, p in enumerate(prompts)]
+    cl.run()
+    pre, dec = cl.engines
+    evs = chrome_trace(pre.telemetry)["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X" and e["pid"] == 2]
+    hand = [e for e in spans if e["name"] == "handoff"]
+    assert len(hand) == len(prompts)
+    assert {e["tid"] for e in hand} == {3}, "handoffs get their own lane"
+    for e in hand:
+        assert e["args"]["pages"] > 0 and e["args"]["bytes"] > 0
+    assert not any(e["name"] == "decode_window" for e in spans)
+    dspans = [
+        e for e in chrome_trace(dec.telemetry)["traceEvents"]
+        if e["ph"] == "X" and e["pid"] == 2
+    ]
+    assert dspans and all(e["name"] == "decode_window" for e in dspans)
+
+
 def test_chrome_trace_engine_lane_carries_ridless_events(model):
     """shed/deferred fire before any rid exists and scripted faults are
     engine-scoped — they render on the engine lane (from the recency
@@ -518,6 +573,17 @@ def test_bench_serving_telemetry_record_contract(tmp_path):
     assert 'replica="0"' in text and 'replica="1"' in text
     assert 'scope="cluster"' in text
     assert rec["serve_requests_finished"] == rec["serve_requests"]
+    # disagg/affinity keys ride EVERY record — flat defaults off the
+    # monolithic dp=2 path (the disagg CI job asserts the live values)
+    assert rec["serve_disagg"] is None
+    assert rec["serve_affinity"] == "off"
+    assert rec["serve_ttft_by_class"] is None
+    assert rec["serve_handoff_count"] == 0
+    assert rec["serve_handoff_pages"] == 0
+    assert rec["serve_handoff_bytes"] == 0
+    assert rec["serve_handoff_failures"] == 0
+    assert rec["serve_prefix_affinity_hits"] == 0
+    assert rec["serve_routed_fallback"] == 0
     for f in rec["serve_timeline_files"]:
         assert os.path.exists(f), f
     names = {os.path.basename(f) for f in rec["serve_timeline_files"]}
