@@ -186,6 +186,20 @@ def build_parser() -> argparse.ArgumentParser:
         "same budget cells gate it)",
     )
     p.add_argument(
+        "--prefill-sp", choices=("off", "on", "both"), default="off",
+        help="which prefill-chunk sharding modes the serving audits "
+        "compile: 'on' additionally audits the SEQUENCE-PARALLEL chunk "
+        "program (ServingEngine prefill_sp knob — the chunk's "
+        "replicated row segments shard over the 'tensor' axis) as its "
+        "own 'prefill_chunk_sp' budget cells; needs --mesh-shape with "
+        "tensor > 1. With --choreo the SP leg is proven per precision "
+        "cell: the SP trace must equal the plain chunk trace op for op "
+        "(resharding only, zero arithmetic change — the bitwise-"
+        "identity gate). With --fusion the SP program's launch "
+        "structure gates against its own DISPATCH_BUDGETS cells. "
+        "'both' = audit off and on (default off)",
+    )
+    p.add_argument(
         "--fusion", action="store_true",
         help="run the SCAN-EQUIVALENCE prover (analysis.fusion) + the "
         "static dispatch/launch budgets (analysis.dispatch, "
@@ -360,6 +374,10 @@ def _layer_scan_modes(args) -> tp.Tuple[str, ...]:
     }[args.layer_scan]
 
 
+def _sp_on(args) -> bool:
+    return getattr(args, "prefill_sp", "off") in ("on", "both")
+
+
 def _run_fusion(args, cfg):
     """The scan-equivalence prover + dispatch budgets (the sixth audit
     family): prove every selected precision x kv x backend cell, then
@@ -390,9 +408,14 @@ def _run_fusion(args, cfg):
                     if not c.ok
                 )
     # launch budgets: structure is precision/backend-invariant (dtypes
-    # change, scan nesting does not) — one trace per layer_scan value
+    # change, scan nesting does not) — one trace per layer_scan value;
+    # with --prefill-sp the sequence-parallel chunk rides along as its
+    # own prefill_chunk_sp cells (resharding must not change launches)
     for ls in ("off", "on"):
-        reports, bad = audit_serving_dispatch(cfg, layer_scan=ls)
+        reports, bad = audit_serving_dispatch(
+            cfg, layer_scan=ls,
+            prefill_sp="on" if _sp_on(args) else "off",
+        )
         out["dispatch"][ls] = {
             name: rep.to_dict() for name, rep in reports.items()
         }
@@ -451,6 +474,27 @@ def _run_choreo(args, cfg):
                         for c in rep.checks
                         if not c.ok
                     )
+            if _sp_on(args):
+                # the sequence-parallel prefill leg: the SP chunk trace
+                # must equal the plain chunk trace op for op (resharding
+                # only — harness.prove_sp_prefill_choreography). Traced
+                # on its own tensor=2 mesh; backend-independent (the SP
+                # reshard wraps the whole block, not the kernel)
+                from midgpt_tpu.analysis.harness import (
+                    prove_sp_prefill_choreography,
+                )
+
+                tag = f"{precision_key(precision, kvq)}/sp"
+                rep = prove_sp_prefill_choreography(
+                    cfg, quant=(precision == "int8"), kv_quant=kvq,
+                )
+                out[tag] = rep.to_dict()
+                ok = ok and rep.ok
+                violations.extend(
+                    f"[choreo/{tag}] {c.name}: {c.detail}"
+                    for c in rep.checks
+                    if not c.ok
+                )
     return out, ok, violations
 
 
@@ -536,6 +580,22 @@ def _run_serving(args, cfg, mesh_shape) -> int:
             page_size=args.serving_page_size,
         ), 1),
     )
+    if _sp_on(args):
+        # the sequence-parallel prefill leg: its own budget cells (the
+        # SP combine is real wire traffic — comms_max pins it) next to
+        # the plain chunk's, same donation/no-host-sync/no-f64 rules
+        if not (mesh_shape and mesh_shape.get("tensor", 1) > 1):
+            print(
+                "error: --prefill-sp needs --mesh-shape with tensor > 1 "
+                "(single-chip SP is a no-op)",
+                file=sys.stderr,
+            )
+            return 2
+        program_specs = program_specs + (
+            ("prefill_chunk_sp", audit_prefill_chunk, dict(
+                page_size=args.serving_page_size, prefill_sp="on",
+            ), 1),
+        )
     if args.serving_spec_sampled:
         # the rejection-sampling verify leg: same program geometry at
         # temperature > 0. It gates against the SAME verify_program

@@ -161,6 +161,32 @@ BUDGETS: tp.Dict[tp.Tuple[str, str, str], tp.Dict[str, int]] = {
         "weights": 8293888, "kv": 1579008, "logits": 8192,
         "constants_max": 245760, "comms_max": 829728,
     },
+    # --- sequence-parallel prefill chunk (ServingEngine prefill_sp,
+    # --prefill-sp on): the SP program streams BYTE-IDENTICAL
+    # weights/kv/logits to the plain chunk cells above — SP moves no
+    # resident bytes; only the wire changes. Measured comms is the plain
+    # chunk's TP collectives (1,769,472 B) + the SP row gathers of the
+    # [1, 64, 768] chunk activations (983,040 B = the "SP combine");
+    # comms_max caps at 1.5x measured, so a program that regathers
+    # anything beyond the SP combine (e.g. a reduce-scatter+all-gather
+    # pair replacing a psum, the bitwise hazard) trips the guard.
+    # Regenerated with --prefill-sp on --mesh-shape tp=2,replica=2. ---
+    ("prefill_chunk_sp", "bf16", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 3145728, "logits": 8192,
+        "constants_max": 245760, "comms_max": 4128768,
+    },
+    ("prefill_chunk_sp", "int8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 3145728, "logits": 8192,
+        "constants_max": 245760, "comms_max": 4128768,
+    },
+    ("prefill_chunk_sp", "bf16-kv8", "replica2,tensor2"): {
+        "weights": 15729152, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 4128768,
+    },
+    ("prefill_chunk_sp", "int8-kv8", "replica2,tensor2"): {
+        "weights": 8293888, "kv": 1579008, "logits": 8192,
+        "constants_max": 245760, "comms_max": 4128768,
+    },
 }
 
 # band half-width for the exact streams: wide enough for layout/padding
@@ -206,6 +232,18 @@ DISPATCH_BUDGETS: tp.Dict[tp.Tuple[str, str], tp.Dict[str, int]] = {
         "layer_scan_length": 2, "host_transfers": 0,
     },
     ("verify_program", "off"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 2,
+        "layer_scan_length": 0, "host_transfers": 0,
+    },
+    # the sequence-parallel chunk: resharding constraints change ZERO
+    # launch structure — the cells are the plain chunk's verbatim, and
+    # that equality is itself the gate (an SP variant that split the
+    # chunk into per-shard dispatches would trip launches_per_window)
+    ("prefill_chunk_sp", "on"): {
+        "launches_per_window": 1, "inlined_layer_bodies": 1,
+        "layer_scan_length": 2, "host_transfers": 0,
+    },
+    ("prefill_chunk_sp", "off"): {
         "launches_per_window": 1, "inlined_layer_bodies": 2,
         "layer_scan_length": 0, "host_transfers": 0,
     },

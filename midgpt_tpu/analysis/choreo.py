@@ -947,6 +947,72 @@ def prove_choreography(
     )
 
 
+def prove_sp_choreography(
+    off: ProgramChoreography,
+    sp: ProgramChoreography,
+) -> ChoreoReport:
+    """The sequence-parallel prefill contract: the SP chunk program
+    (``ServingEngine(prefill_sp="on")``) must be the plain chunk program
+    PLUS DATA MOVEMENT AND NOTHING ELSE. Row-sharding the chunk's
+    replicated segments over the 'tensor' axis inserts only
+    ``sharding_constraint`` ops — pass-through, outside the arithmetic
+    alphabet — so the two programs' normalized traces must be IDENTICAL
+    op for op: one differing record means SP changed arithmetic, which
+    is exactly the bitwise-identity hazard (a reduce-scatter substituted
+    for an all-reduce reassociates the psum and flips near-tied greedy
+    argmaxes the same way the PR 4/PR 5 drifts did). Both traces must
+    come from the same mesh so the comparison isolates the prefill_sp
+    knob."""
+    checks: tp.List[ChoreoCheck] = []
+    diff = _first_diff(off.attention, sp.attention)
+    checks.append(ChoreoCheck(
+        name="sp-prefill-mirrors-off",
+        ok=not diff,
+        detail=diff,
+    ))
+    sig_ok = off.softmax == sp.softmax
+    checks.append(ChoreoCheck(
+        name="sp-prefill-softmax-identical",
+        ok=sig_ok,
+        detail=(
+            ""
+            if sig_ok
+            else f"{off.softmax.describe()} != {sp.softmax.describe()}"
+        ),
+    ))
+    head_ok = (
+        off.lm_head == sp.lm_head
+        and off.lm_head_epilogue == sp.lm_head_epilogue
+    )
+    checks.append(ChoreoCheck(
+        name="sp-prefill-lm-head-identical",
+        ok=head_ok,
+        detail=(
+            ""
+            if head_ok
+            else f"{off.lm_head} ep={off.lm_head_epilogue} != "
+            f"{sp.lm_head} ep={sp.lm_head_epilogue}"
+        ),
+    ))
+    struct_ok = (
+        off.n_layers == sp.n_layers
+        and off.kernelized == sp.kernelized
+        and off.kv_dequant == sp.kv_dequant
+    )
+    checks.append(ChoreoCheck(
+        name="sp-prefill-structure-identical",
+        ok=struct_ok,
+        detail=(
+            ""
+            if struct_ok
+            else f"layers {off.n_layers}/{sp.n_layers} kernelized "
+            f"{off.kernelized}/{sp.kernelized} kv_dequant "
+            f"{off.kv_dequant}/{sp.kv_dequant}"
+        ),
+    ))
+    return ChoreoReport(checks=tuple(checks), programs=(off, sp))
+
+
 # ---------------------------------------------------------------------------
 # the sampled-verify prover (temperature > 0)
 # ---------------------------------------------------------------------------

@@ -561,6 +561,7 @@ def compile_prefill_chunk(
     quant: bool = False,
     kv_quant: bool = False,
     layer_scan: str = "off",
+    prefill_sp: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
 ):
     """Compile the serving engine's prefill-chunk program
@@ -576,12 +577,24 @@ def compile_prefill_chunk(
     block table the chunk reads through may alias pages shared with
     other live slots (copy-on-write guarantees they are read-only); the
     compiled program is identical either way, which is exactly why the
-    audit covers the sharing case."""
+    audit covers the sharing case.
+
+    ``prefill_sp="on"`` compiles the SEQUENCE-PARALLEL chunk program
+    (``ServingEngine(prefill_sp=...)``): the chunk's replicated row
+    segments shard over the 'tensor' axis, so with --traffic the SP
+    combine collectives land in ``comms`` — the budget cell for the
+    ``prefill_chunk_sp`` program pins that wire traffic (and nothing
+    else) via its ``comms_max``. Requires a sharded ``mesh_shape`` with
+    tensor > 1 (single-chip SP would be a no-op audit)."""
     import jax
     import numpy as np_
 
     from midgpt_tpu.serving.engine import make_prefill_chunk_program
 
+    assert prefill_sp in ("off", "on"), prefill_sp
+    assert prefill_sp == "off" or (
+        mesh_shape and mesh_shape.get("tensor", 1) > 1
+    ), "prefill_sp='on' audits need a --mesh-shape with tensor > 1"
     model_cfg, mesh, model, pmax, pool, logits, wshapes, prog_mesh = (
         _serving_audit_setup(
             cfg, slots=4, page_size=page_size, shrink=shrink, quant=quant,
@@ -592,7 +605,7 @@ def compile_prefill_chunk(
     chunk_fn = make_prefill_chunk_program(
         model, chunk_len=chunk_len, pmax=pmax,
         rope_len=model_cfg.block_size, mesh=prog_mesh,
-        layer_scan=layer_scan,
+        layer_scan=layer_scan, prefill_sp=prefill_sp,
     )
     i32 = lambda *shape: np_.zeros(shape, np_.int32)  # noqa: E731
     hlo = chunk_fn.lower(
@@ -624,6 +637,7 @@ def audit_prefill_chunk(
     quant: bool = False,
     kv_quant: bool = False,
     layer_scan: str = "off",
+    prefill_sp: str = "off",
     mesh_shape: tp.Optional[tp.Mapping[str, int]] = None,
     traffic: bool = False,
 ):
@@ -642,7 +656,7 @@ def audit_prefill_chunk(
         compile_prefill_chunk(
             cfg, chunk_len=chunk_len, page_size=page_size, shrink=shrink,
             quant=quant, kv_quant=kv_quant, layer_scan=layer_scan,
-            mesh_shape=mesh_shape,
+            prefill_sp=prefill_sp, mesh_shape=mesh_shape,
         )
     )
     analysis = StepAnalysis.from_text(
@@ -653,9 +667,12 @@ def audit_prefill_chunk(
         donated_leaves=donated,
     )
     report = _serving_rules(wshapes, payload, 1).evaluate(analysis)
+    program = (
+        "prefill_chunk_sp" if prefill_sp == "on" else "prefill_chunk"
+    )
     if traffic:
         return analysis, report, _serving_traffic(
-            "prefill_chunk", analysis, keys, window_steps=1
+            program, analysis, keys, window_steps=1
         )
     return analysis, report
 
@@ -910,6 +927,78 @@ ChoreoReport`.
     return report
 
 
+def prove_sp_prefill_choreography(
+    name_or_cfg: tp.Union[str, ExperimentConfig],
+    *,
+    quant: bool = False,
+    kv_quant: bool = False,
+    layer_scan: str = "off",
+    tp_size: int = 2,
+    chunk_len: int = 16,
+    page_size: int = 16,
+):
+    """The sequence-parallel prefill leg of the choreography suite:
+    trace the prefill-chunk program TWICE on one ``tensor=tp_size`` mesh
+    — ``prefill_sp`` off and on, through the very jitted factory the
+    engine launches — and prove the two normalized traces identical op
+    for op (:func:`~midgpt_tpu.analysis.choreo.prove_sp_choreography`).
+    SP row-shards the chunk's replicated segments over 'tensor' with
+    ``sharding_constraint`` ops only; any arithmetic difference between
+    the traces is a bitwise-identity hazard (the landing gate for
+    ``ServingEngine(prefill_sp=...)``). Tracing only — no compilation;
+    needs ``tp_size`` visible devices for the mesh the constraints
+    name."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from midgpt_tpu.analysis.choreo import (
+        extract_choreography,
+        prove_sp_choreography,
+    )
+    from midgpt_tpu.config import MeshConfig
+    from midgpt_tpu.models.gpt import GPT
+    from midgpt_tpu.parallel.mesh import create_mesh
+    from midgpt_tpu.pytree import cast_floating
+    from midgpt_tpu.serving.engine import trace_serving_programs
+
+    cfg = (
+        get_config(name_or_cfg)
+        if isinstance(name_or_cfg, str)
+        else name_or_cfg
+    )
+    model_cfg = _dc.replace(
+        cfg.model, n_layer=2, block_size=64, vocab_size=128,
+        remat="none", scan_unroll=1,
+    )
+    assert model_cfg.kv_heads % tp_size == 0, (
+        f"tensor={tp_size} must divide kv_heads {model_cfg.kv_heads}"
+    )
+    model = cast_floating(
+        GPT.init(jax.random.PRNGKey(0), model_cfg), jnp.bfloat16
+    )
+    if quant:
+        from midgpt_tpu.quant import quantize_model
+
+        model = quantize_model(model)
+    mesh = create_mesh(
+        MeshConfig(replica=1, fsdp=1, sequence=1, tensor=tp_size),
+        devices=jax.devices()[:tp_size],
+    )
+    kw = dict(
+        slots=4, window=2, spec_len=2, chunk_len=chunk_len,
+        page_size=page_size, kv_quant="int8" if kv_quant else None,
+        layer_scan=layer_scan, mesh=mesh,
+    )
+    off = trace_serving_programs(model, prefill_sp="off", **kw)
+    on = trace_serving_programs(model, prefill_sp="on", **kw)
+    return prove_sp_choreography(
+        extract_choreography("prefill_chunk", off["prefill_chunk"]),
+        extract_choreography("prefill_chunk_sp", on["prefill_chunk"]),
+    )
+
+
 def prove_scan_equivalence(
     name_or_cfg: tp.Union[str, ExperimentConfig],
     *,
@@ -972,6 +1061,7 @@ def serving_dispatch_reports(
     name_or_cfg: tp.Union[str, ExperimentConfig],
     *,
     layer_scan: str = "off",
+    prefill_sp: str = "off",
     quant: bool = False,
     kv_quant: bool = False,
     paged_kernel: str = "xla",
@@ -992,7 +1082,11 @@ def serving_dispatch_reports(
     nesting) — the flags exist so fault-injection tests can audit any
     cell they traced. ``temperature > 0`` audits the SAMPLED programs
     against the same cells: rejection-sampling acceptance is in-program
-    arithmetic and must not change the launch structure."""
+    arithmetic and must not change the launch structure.
+    ``prefill_sp="on"`` additionally traces the sequence-parallel chunk
+    program on a tensor=2 mesh and reports it as ``prefill_chunk_sp``:
+    SP is resharding only, so its launch structure must equal the plain
+    chunk's (its own DISPATCH_BUDGETS cells pin exactly that)."""
     import dataclasses as _dc
 
     import jax
@@ -1026,7 +1120,7 @@ def serving_dispatch_reports(
         paged_kernel=paged_kernel, layer_scan=layer_scan,
         temperature=temperature, top_k=top_k,
     )
-    return {
+    out = {
         "decode_window": dispatch_report(
             jaxprs["decode_window"], program="decode_window",
             window_steps=window,
@@ -1038,6 +1132,27 @@ def serving_dispatch_reports(
             jaxprs["verify"], program="verify_program",
         ),
     }
+    if prefill_sp == "on":
+        from midgpt_tpu.config import MeshConfig
+        from midgpt_tpu.parallel.mesh import create_mesh
+
+        assert model_cfg.kv_heads % 2 == 0, model_cfg.kv_heads
+        mesh = create_mesh(
+            MeshConfig(replica=1, fsdp=1, sequence=1, tensor=2),
+            devices=jax.devices()[:2],
+        )
+        sp_jaxprs = trace_serving_programs(
+            model, slots=slots, window=window, spec_len=spec_len,
+            chunk_len=chunk_len, page_size=page_size,
+            kv_quant="int8" if kv_quant else None,
+            paged_kernel=paged_kernel, layer_scan=layer_scan,
+            prefill_sp="on", mesh=mesh,
+            temperature=temperature, top_k=top_k,
+        )
+        out["prefill_chunk_sp"] = dispatch_report(
+            sp_jaxprs["prefill_chunk"], program="prefill_chunk_sp",
+        )
+    return out
 
 
 def audit_serving_dispatch(

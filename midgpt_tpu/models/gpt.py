@@ -1382,14 +1382,45 @@ class Block:
     def prefill_paged_at(
         self, x, pool_k, pool_v, bt, layer, mask_pool, mask_self,
         sin_rows, cos_rows, start=None, pool_sk=None, pool_sv=None,
+        sp=False,
     ):
+        if not sp:
+            attn_out, k, v = self.attn.prefill_paged_at(
+                self.ln1(x), pool_k, pool_v, bt, layer, mask_pool,
+                mask_self, sin_rows, cos_rows, start=start,
+                pool_sk=pool_sk, pool_sv=pool_sv,
+            )
+            x = x + attn_out
+            x = x + mlp_call(self.mlp, self.ln2(x))[0]
+            return x, k, v
+        # Sequence-parallel prefill (Megatron-SP style): the per-token
+        # segments that tensor parallelism leaves REPLICATED — ln1/ln2,
+        # both residual adds — run with the chunk's T rows sharded over
+        # 'tensor' (the 'sp' logical axis), and a pure all-gather of
+        # rows restores full T before each parallel region. Every
+        # floating-point op keeps its exact off-path operands: a row's
+        # layernorm reduces over D inside that row, the gathers move
+        # bytes without touching values, and the attention/matmul block
+        # below is the IDENTICAL head-parallel arithmetic (one joint
+        # softmax — the choreo prover checks the same signature either
+        # way). Pinning attn/mlp outputs replicated BEFORE re-sharding
+        # rows keeps the row-parallel psum an all-reduce — left free,
+        # GSPMD may fuse it to reduce-scatter, whose partial-sum order
+        # is not contractually the all-reduce's (the PR 9 lse-merge
+        # lesson, one level down). That is what makes sp=True bitwise
+        # against sp=False by construction rather than by tolerance.
+        x = shard_act(x, None, "sp", None)
+        h1 = shard_act(self.ln1(x), None, None, None)  # gather rows
         attn_out, k, v = self.attn.prefill_paged_at(
-            self.ln1(x), pool_k, pool_v, bt, layer, mask_pool, mask_self,
+            h1, pool_k, pool_v, bt, layer, mask_pool, mask_self,
             sin_rows, cos_rows, start=start, pool_sk=pool_sk,
             pool_sv=pool_sv,
         )
-        x = x + attn_out
-        x = x + mlp_call(self.mlp, self.ln2(x))[0]
+        attn_out = shard_act(attn_out, None, None, None)  # pin the psum
+        x = x + shard_act(attn_out, None, "sp", None)
+        h2 = shard_act(self.ln2(x), None, None, None)  # gather rows
+        mlp_out = shard_act(mlp_call(self.mlp, h2)[0], None, None, None)
+        x = x + shard_act(mlp_out, None, "sp", None)
         return x, k, v
 
     def verify_paged_at(
@@ -1880,12 +1911,23 @@ def prefill_chunk_paged(
     pool_sk: tp.Optional[Array] = None,  # [L, NP, Hkv] f32 (int8 pool)
     pool_sv: tp.Optional[Array] = None,
     layer_scan: str = "off",
+    sp: bool = False,
 ) -> tp.Tuple[Array, Array, Array]:
     """Suffix-only prefill of one chunk against a pre-populated block
     table: the chunk's tokens (context positions ``start .. start+T-1``)
     attend to everything already resident in the slot's pages (positions
     ``< start`` — the prefix-cache hit and/or earlier chunks of the same
     prompt) plus themselves, causally, in one joint softmax per layer.
+
+    ``sp=True`` (ServingEngine ``prefill_sp``) is the sequence-parallel
+    variant: the chunk's T rows are sharded over 'tensor' (logical axis
+    'sp') through every segment tensor parallelism otherwise replicates
+    — embedding output, ln1/ln2, the residual adds, ln_f — with row
+    all-gathers restoring full T at each parallel-region boundary. The
+    attention and matmul arithmetic is byte-for-byte the sp=False code
+    (same one-joint-softmax choreography; see Block.prefill_paged_at),
+    so streams are bitwise identical while the replicated O(T·D)
+    per-token work and activation traffic scale 1/tp.
 
     This is what makes both tentpole features exact rather than
     approximate: a prefix-cache hit skips the cached pages' prefill
@@ -1927,6 +1969,11 @@ def prefill_chunk_paged(
     cos_rows = jnp.take(cos_t, pos, axis=0)
 
     h = embed_tokens(model.wte, tokens)  # [1, T, D]
+    if sp:
+        # pin the embedding's vocab psum replicated (identical all-reduce
+        # to the sp=False trace) before slicing rows locally
+        h = shard_act(h, None, None, None)
+        h = shard_act(h, None, "sp", None)
     sin_h, cos_h = sin_rows.astype(h.dtype), cos_rows.astype(h.dtype)
     assert layer_scan in ("on", "off"), layer_scan
     if layer_scan == "on":
@@ -1943,6 +1990,7 @@ def prefill_chunk_paged(
             hc, k, v = block.prefill_paged_at(
                 hc, pk_l[None], pv_l[None], bt, 0, mask_pool, mask_self,
                 sin_h, cos_h, start=start, pool_sk=sk_l, pool_sv=sv_l,
+                sp=sp,
             )
             return hc, (k, v)
 
@@ -1957,11 +2005,17 @@ def prefill_chunk_paged(
             h, k, v = block.prefill_paged_at(
                 h, pool_k, pool_v, bt, i, mask_pool, mask_self, sin_h,
                 cos_h, start=start, pool_sk=pool_sk, pool_sv=pool_sv,
+                sp=sp,
             )
             ks.append(k)
             vs.append(v)
         ks, vs = jnp.stack(ks), jnp.stack(vs)
     h = model.ln_f(h)
+    if sp:
+        # final ln_f ran row-sharded; gather the chunk back replicated so
+        # the caller's last-real-row slice and lm-head projection are the
+        # sp=False trace verbatim
+        h = shard_act(h, None, None, None)
     ks = shard_act(ks, None, None, "kv_heads", None, None)
     vs = shard_act(vs, None, None, "kv_heads", None, None)
     return h, ks, vs  # ks/vs: [L, 1, Hkv, T, C]

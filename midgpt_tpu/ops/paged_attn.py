@@ -90,6 +90,32 @@ def _interpret_default() -> bool:
     return not is_tpu_backend()
 
 
+# Conservative fit budget for the VMEM assembly + score scratch, out of
+# ~16 MB/core. Module-level so the long-context gate tests can pin the
+# rejection arithmetic against the same constant the ``auto`` path uses.
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def vmem_bytes(pmax: int, page_size: int, hkv: int, c: int,
+               itemsize: int, groups: int = 8, spec_t: int = 1) -> int:
+    """Worst-case VMEM demand of the kernel at this geometry, in bytes:
+    the K + V assembly scratch at pool dtype, the f32 dequant/upcast
+    views ``_dequant_view`` materializes on top of a sub-f32 pool, and
+    f32 score/prob headroom ([Hkv, G, T, W] x4 for scores + probs + exp
+    temps). Exposed separately from :func:`supported` so the
+    long-context tests can pin the arithmetic itself — at 100k-token
+    Pmax the assembly alone is tens of MB and the gate must reject from
+    the byte count, not from a tuned special case."""
+    w = pmax * page_size
+    assembly = 2 * hkv * c * w * itemsize
+    if itemsize < 4:
+        # f32 ck/cv views of the K and V assemblies
+        assembly += 2 * hkv * c * w * 4
+    # [Hkv, G, T, W] f32, x4 headroom (scores + probs + exp temps)
+    scores = 4 * hkv * max(1, groups) * max(1, spec_t) * w * 4
+    return assembly + scores
+
+
 def supported(pmax: int, page_size: int, hkv: int, c: int,
               itemsize: int, groups: int = 8, spec_t: int = 1) -> bool:
     """Does the assembly scratch for this geometry fit comfortably in
@@ -104,14 +130,9 @@ def supported(pmax: int, page_size: int, hkv: int, c: int,
     ``_dequant_view`` builds on top of the pool-dtype scratch; omitting
     them let ``auto`` pick the kernel on geometries whose real VMEM
     demand overflowed Mosaic (code-review finding)."""
-    w = pmax * page_size
-    assembly = 2 * hkv * c * w * itemsize
-    if itemsize < 4:
-        # f32 ck/cv views of the K and V assemblies
-        assembly += 2 * hkv * c * w * 4
-    # [Hkv, G, T, W] f32, x4 headroom (scores + probs + exp temps)
-    scores = 4 * hkv * max(1, groups) * max(1, spec_t) * w * 4
-    return assembly + scores <= 12 * 1024 * 1024
+    return vmem_bytes(
+        pmax, page_size, hkv, c, itemsize, groups=groups, spec_t=spec_t
+    ) <= VMEM_BUDGET
 
 
 def _dequant_view(buf: Array, scales_ref, hkv: int, pmax: int,

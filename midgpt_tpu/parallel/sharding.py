@@ -46,6 +46,11 @@ DEFAULT_LOGICAL_RULES: LogicalRules = {
     "expert": "tensor",  # MoE expert-parallel axis (models/gpt.MoEMLP)
     "vocab": "tensor",
     "layers": None,
+    # sequence-parallel row axis of the serving SP prefill program
+    # (models/gpt.prefill_chunk_paged sp=True): unmapped by default —
+    # only serving_logical_rules(prefill_sp="on") binds it to 'tensor',
+    # so training paths and every other serving program never see it
+    "sp": None,
 }
 
 

@@ -112,6 +112,7 @@ from midgpt_tpu.serving.telemetry import (
     write_json,
 )
 from midgpt_tpu.serving.paged import (
+    HostSpillStore,
     PageAllocator,
     PagedKVPool,
     PrefixIndex,
@@ -140,7 +141,7 @@ Array = jax.Array
 _PROGRAM_CACHE: tp.Dict[tp.Tuple, tp.Any] = {}
 
 
-def serving_logical_rules() -> tp.Dict[str, tp.Any]:
+def serving_logical_rules(prefill_sp: str = "off") -> tp.Dict[str, tp.Any]:
     """The activation logical-rule table the serving programs compile
     under: the training table with 'batch' and 'seq' unmapped. Inside
     ONE engine the slot dim is NEVER a sharded axis — data parallelism
@@ -154,10 +155,22 @@ def serving_logical_rules() -> tp.Dict[str, tp.Any]:
     no-batch-allgather-in-page-gather audit rule flags; found by that
     rule on the first tp=2,replica=2 audit.) 'seq' is unmapped for the
     same reason: decode is one token deep and a prefill chunk is one
-    slot wide — there is nothing to shard."""
+    slot wide — there is nothing to shard.
+
+    The one exception is the SP prefill-chunk program
+    (``prefill_sp="on"``): a long-prompt chunk IS many tokens deep, and
+    its replicated per-token segments shard their rows over 'tensor'
+    through the dedicated 'sp' logical axis
+    (models.gpt.prefill_chunk_paged sp=True). 'sp' stays unmapped for
+    every other program — decode/verify never see the axis, so no
+    decode bytes move when the knob flips."""
     from midgpt_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES
 
-    return {**DEFAULT_LOGICAL_RULES, "batch": None, "seq": None}
+    assert prefill_sp in ("on", "off"), prefill_sp
+    rules = {**DEFAULT_LOGICAL_RULES, "batch": None, "seq": None}
+    if prefill_sp == "on":
+        rules["sp"] = "tensor"
+    return rules
 
 
 def _mesh_key(mesh) -> tp.Optional[tp.Tuple]:
@@ -350,24 +363,25 @@ def _build_decode_window(
 
 def make_prefill_chunk_program(
     model: GPT, *, chunk_len: int, pmax: int, rope_len: int, mesh=None,
-    layer_scan: str = "off",
+    layer_scan: str = "off", prefill_sp: str = "off",
 ):
     key = (
         "prefill_chunk", model.config, chunk_len, pmax, rope_len,
-        layer_scan, _mesh_key(mesh),
+        layer_scan, prefill_sp, _mesh_key(mesh),
     )
     return _cached_program(
         key,
         lambda: _build_prefill_chunk_program(
             model.config, chunk_len=chunk_len, pmax=pmax,
             rope_len=rope_len, mesh=mesh, layer_scan=layer_scan,
+            prefill_sp=prefill_sp,
         ),
     )
 
 
 def _build_prefill_chunk_program(
     cfg, *, chunk_len: int, pmax: int, rope_len: int, mesh,
-    layer_scan: str = "off",
+    layer_scan: str = "off", prefill_sp: str = "off",
 ):
     """A prefill-chunk program for one padded chunk length: one forward
     over the chunk's tokens attending to the slot's already-resident
@@ -395,11 +409,11 @@ def _build_prefill_chunk_program(
         real_n: Array,  # [] int32 — real tokens in this chunk
         bt_row: Array,  # [pmax] int32 — the slot's block table
     ):
-        with axis_rules(mesh, serving_logical_rules()):
+        with axis_rules(mesh, serving_logical_rules(prefill_sp)):
             h, ks, vs = prefill_chunk_paged(
                 model, tokens, start, pool.k, pool.v, bt_row[None, :],
                 rope_len, pool_sk=pool.scale_k, pool_sv=pool.scale_v,
-                layer_scan=layer_scan,
+                layer_scan=layer_scan, sp=(prefill_sp == "on"),
             )  # h: [1, T, D]; ks/vs: [L, 1, Hkv, T, C]
             h_last = jax.lax.dynamic_slice_in_dim(
                 h, real_n - 1, 1, axis=1
@@ -801,6 +815,7 @@ def trace_serving_programs(
     kv_quant: tp.Optional[str] = None,
     paged_kernel: str = "xla",
     layer_scan: str = "off",
+    prefill_sp: str = "off",
     temperature: float = 0.0,
     top_k: tp.Optional[int] = None,
 ) -> tp.Dict[str, tp.Any]:
@@ -846,7 +861,7 @@ def trace_serving_programs(
     )
     chunk_fn = make_prefill_chunk_program(
         model, chunk_len=chunk_len, pmax=pmax, rope_len=cfg.block_size,
-        mesh=mesh, layer_scan=layer_scan,
+        mesh=mesh, layer_scan=layer_scan, prefill_sp=prefill_sp,
     )
     chunk_jaxpr = jax.make_jaxpr(chunk_fn)(
         model, pool, logits, i32(), i32(1, chunk_len), i32(), i32(),
@@ -997,6 +1012,10 @@ _ENGINE_COUNTERS = (
     "prompt_tokens_cached",
     "prefill_tokens_computed",
     "cold_reclaims",
+    "spilled_pages",
+    "spill_faultback_pages",
+    "spill_readmissions",
+    "spill_discards",
     "verify_dispatches",
     "spec_drafted",
     "spec_accepted",
@@ -1103,6 +1122,9 @@ class ServingEngine:
         kv_quant: tp.Optional[str] = None,
         paged_kernel: str = "auto",
         layer_scan: str = "off",
+        prefill_sp: str = "auto",
+        spill: str = "off",
+        spill_budget_pages: tp.Optional[int] = None,
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
         max_queue: tp.Optional[int] = None,
@@ -1204,6 +1226,19 @@ class ServingEngine:
         # bench ladder runs both).
         assert layer_scan in ("on", "off"), layer_scan
         self.layer_scan = layer_scan
+        # sequence-parallel prefill (ROADMAP item 4): "on" compiles the
+        # SP prefill-chunk variant (models.gpt.prefill_chunk_paged
+        # sp=True) whose replicated per-token segments shard the chunk's
+        # rows over 'tensor' — bitwise the "off" program (the landing
+        # gate), with the replicated O(T·D) work and activation traffic
+        # scaled 1/tp on long prompts. "auto" = on exactly when the mesh
+        # has a tensor axis to shard over; resolved below once tp is
+        # known (a tp=1 "on" degenerates to "off": there is no axis, and
+        # keeping the resolved value in the program-cache key stops a
+        # no-op knob from forking compilations). Decode/verify programs
+        # are untouched by construction — separate cache entries, and
+        # the 'sp' logical axis is unmapped for them.
+        assert prefill_sp in ("auto", "on", "off"), prefill_sp
         # quantized weight path (midgpt_tpu.quant): quant="int8" converts
         # the model to the int8 per-channel serving pytree here, so every
         # program this engine compiles (decode window, prefill chunk,
@@ -1293,6 +1328,9 @@ class ServingEngine:
             model = jax.device_put(
                 model, param_shardings(mesh, model, GPT_PARAM_RULES)
             )
+        self.prefill_sp = "on" if (
+            prefill_sp in ("on", "auto") and self.tp > 1
+        ) else "off"
         self.model = model
         self.slots = slots
         self.window = window
@@ -1306,6 +1344,33 @@ class ServingEngine:
         self.alloc = PageAllocator(num_pages)
         self.prefix_cache = prefix_cache
         self.index = PrefixIndex(page_size) if prefix_cache else None
+        # cold-page host spill (ROADMAP item 4): under pool pressure,
+        # cold (refcount-0 cached) pages move to host RAM — content,
+        # int8 scale planes and prefix-index position preserved —
+        # instead of being discarded, and fault back through the jitted
+        # page-write path (import_pages) on a prefix hit or
+        # re-admission. The HBM page id returns to the free list at
+        # spill time (that is what frees capacity), so the allocator's
+        # id-state identity free+held+cached+quarantined == num_pages is
+        # untouched while `spilled` counts host-store entries — the
+        # extended ledger the invariant tests check is
+        # resident-indexed + spilled == indexed nodes, disjoint
+        # (PrefixIndex.check with the store). Spill is a CACHE policy:
+        # it needs the prefix index, and a request that cannot fault a
+        # spilled node back (pool fully held) degrades to a shorter
+        # match, never an error — parking/PoolOverloaded stay the
+        # overload surface.
+        assert spill in ("on", "off"), spill
+        assert spill == "off" or prefix_cache, (
+            "spill='on' requires prefix_cache=True: only indexed cold "
+            "pages ever spill"
+        )
+        assert spill_budget_pages is None or spill_budget_pages >= 0
+        self.spill = spill
+        self._spill_store = (
+            HostSpillStore(budget_pages=spill_budget_pages)
+            if spill == "on" else None
+        )
         self.prefill_chunk = prefill_chunk
         # tokens of prefill work allowed between decode windows; the
         # first chunk always runs (progress guarantee), so the effective
@@ -1479,6 +1544,12 @@ class ServingEngine:
         g = self.metrics.gauge
         g("free_pages", lambda: self.alloc.free_pages)
         g("cached_pages", lambda: self.alloc.cached_pages)
+        g("spill_resident_pages",
+          lambda: len(self._spill_store)
+          if self._spill_store is not None else 0)
+        g("spill_resident_bytes",
+          lambda: self._spill_store.nbytes
+          if self._spill_store is not None else 0)
         g("pool_utilization",
           lambda: 1.0 - self.alloc.free_pages / max(1, self.alloc.num_pages))
         g("queue_depth", lambda: len(self.queue))
@@ -1937,17 +2008,94 @@ class ServingEngine:
         cannot produce them. refcount>0 pages are never touched, which is
         why callers PIN (incref) any matched chain before reserving —
         attempt-based rather than counting-based, because a cold page is
-        only reclaimable once no held page chains through it."""
+        only reclaimable once no held page chains through it.
+
+        With ``spill="on"`` the same LRU-leaf-first order SPILLS instead
+        of discarding: the victim's payload (all layers + int8 scale
+        planes) exports to the host store, the index re-keys the node
+        virtual (still matchable), and only then does the HBM id return
+        to the free list. Past ``spill_budget_pages`` the oldest spilled
+        prefixes are forgotten outright — bounded host residency, with
+        plain reclaim as the degradation floor."""
         while not self.alloc.can_alloc(n):
-            victim = (
-                self.index.evict_cold_leaf() if self.index is not None
-                else None
-            )
-            if victim is None:
+            if self.index is None:
                 return False
-            self.alloc.reclaim(victim)
-            self.cold_reclaims += 1
+            if self._spill_store is not None:
+                victim = self.index.coldest_leaf()
+                if victim is None:
+                    return False
+                payload = export_pages(self.pool, [victim])
+                vid = self.index.spill(victim)
+                self._spill_store.put(vid, payload)
+                self.alloc.reclaim(victim)
+                self.spilled_pages += 1
+                while self._spill_store.over_budget:
+                    dropped = self.index.discard_spilled_oldest()
+                    assert dropped is not None
+                    self._spill_store.pop(dropped)
+                    self.spill_discards += 1
+            else:
+                victim = self.index.evict_cold_leaf()
+                if victim is None:
+                    return False
+                self.alloc.reclaim(victim)
+                self.cold_reclaims += 1
         return True
+
+    def _fault_back(self, vid: int) -> tp.Optional[int]:
+        """Restore one spilled node to a freshly allocated resident page
+        through the jitted page-write path (import_pages — byte-exact,
+        so the revived prefix reads back bit-identically). Returns the
+        new page id at refcount 1 (the caller's pin), or None when the
+        pool cannot produce a page even by spilling others — the caller
+        degrades to a shorter prefix match instead of wedging."""
+        assert self._spill_store is not None and self.index is not None
+        if not self._try_reserve(1):
+            return None
+        [page] = self.alloc.alloc(1)
+        k, v, sk, sv = self._spill_store.pop(vid)
+        self.pool = import_pages(self.pool, [page], k, v, sk, sv)
+        self.index.unspill(vid, page)
+        self.spill_faultback_pages += 1
+        return page
+
+    def _fault_back_matched(
+        self,
+        full: tp.List[int],
+        cow_src: tp.Optional[int],
+        matched: int,
+    ) -> tp.Tuple[tp.List[int], tp.Optional[int], int, tp.Set[int]]:
+        """Materialize any spilled nodes a prefix match walked onto.
+        Spilled subtrees are closed downward, so the spilled nodes of a
+        matched chain form a SUFFIX of ``full`` (plus possibly the COW
+        source, a child of the tail): fault them back in chain order —
+        each parent must be resident before its child re-keys under it.
+        Returns the match with virtual ids replaced by resident page
+        ids, plus the set of pages already holding their pin (alloc at
+        refcount 1 — the pin loop must not incref them again). A failed
+        fault-back truncates the match at that node — the dropped
+        tokens recompute, the stream is unchanged."""
+        prepinned: tp.Set[int] = set()
+        if self._spill_store is None:
+            return full, cow_src, matched, prepinned
+        for i, node in enumerate(full):
+            if not self.index.is_spilled(node):
+                continue
+            page = self._fault_back(node)
+            if page is None:
+                # drop the spilled suffix (and the COW source — it
+                # chains under the tail); those tokens just recompute
+                full = full[:i]
+                return full, None, len(full) * self.page_size, prepinned
+            full[i] = page
+            prepinned.add(page)
+        if cow_src is not None and self.index.is_spilled(cow_src):
+            page = self._fault_back(cow_src)
+            if page is None:
+                return full, None, len(full) * self.page_size, prepinned
+            cow_src = page
+            prepinned.add(page)
+        return full, cow_src, matched, prepinned
 
     def _release_pages(self, pages: tp.Iterable[int]) -> None:
         """Decref a request's pages: indexed ones retire to the cold
@@ -2024,11 +2172,25 @@ class ServingEngine:
                 full, cow_src, matched = self.index.match(req.prompt[: p - 1])
             # PIN the matched chain (and the COW source, until its copy
             # lands) before reserving: revived out of the LRU, the
-            # reservation below can never reclaim them out from under us
-            pinned = list(full) + ([cow_src] if cow_src is not None else [])
+            # reservation below can never reclaim (or spill) them out
+            # from under us. Spilled nodes — virtual ids forming a
+            # suffix of the chain (spilled subtrees are closed
+            # downward), possibly plus the COW source — cannot be
+            # increfed: they fault back AFTER the resident pins land,
+            # each returning a fresh page already carrying its pin at
+            # refcount 1.
+            cand = list(full) + ([cow_src] if cow_src is not None else [])
+            pinned = [
+                pg for pg in cand if not self.index.is_spilled(pg)
+            ] if self.index is not None else []
             for pg in pinned:
                 self.alloc.incref(pg)
                 self.index.revive(pg)
+            if self._spill_store is not None:
+                full, cow_src, matched, prepinned = (
+                    self._fault_back_matched(full, cow_src, matched)
+                )
+                pinned.extend(sorted(prepinned))
             need = pages_needed(p, self.page_size) - len(full)
             if not self._try_reserve(need):
                 # head-of-line blocks: unpin and wait for pages to free
@@ -2110,6 +2272,7 @@ class ServingEngine:
                 rope_len=self.block,
                 mesh=self._mesh,
                 layer_scan=self.layer_scan,
+                prefill_sp=self.prefill_sp,
             )
         tele = self.telemetry
         t0 = self.clock() if tele is not None else 0.0
@@ -2196,6 +2359,15 @@ class ServingEngine:
             page = int(self.bt[s, i])
             chunk = ctx[i * ps : (i + 1) * ps]
             canonical = self.index.register(self.slot_node[s], chunk, page)
+            if canonical != page and self.index.is_spilled(canonical):
+                # re-admission of a spilled prefix: identical content was
+                # just recomputed into a resident page, so adopt OUR page
+                # as the node (re-key, byte-identical by the chain hash)
+                # and drop the host payload — no import dispatch needed
+                self._spill_store.pop(canonical)
+                self.index.unspill(canonical, page)
+                self.spill_readmissions += 1
+                canonical = page
             if canonical != page:
                 # identical content was indexed first by someone else: our
                 # page stays private (freed, not cached, at release) and
@@ -2675,6 +2847,7 @@ class ServingEngine:
                     rope_len=self.block,
                     mesh=self._mesh,
                     layer_scan=self.layer_scan,
+                    prefill_sp=self.prefill_sp,
                 )
             self.pool, self.logits = self._chunk_fns[b](
                 self.model,
@@ -2689,19 +2862,30 @@ class ServingEngine:
         return buckets
 
     def clear_prefix_cache(self) -> int:
-        """Reclaim every COLD cached page (refcount-0 resident prefixes);
-        returns the count. Live slots' pages are untouched. Benchmarks
-        call this after warmup so measured hit rates come from the
+        """Reclaim every COLD cached page (refcount-0 resident prefixes)
+        AND forget every host-spilled prefix; returns the total dropped.
+        Live slots' pages are untouched. Benchmarks call this after
+        warmup so measured hit rates (and spill counts) come from the
         measured trace alone."""
         n = 0
         if self.index is None:
             return n
+        # spilled nodes first: they hang below cold resident pages, and
+        # evict_cold_leaf skips any page with children (even virtual)
+        if self._spill_store is not None:
+            while True:
+                vid = self.index.discard_spilled_oldest()
+                if vid is None:
+                    break
+                self._spill_store.pop(vid)
+                n += 1
         while True:
             victim = self.index.evict_cold_leaf()
             if victim is None:
-                return n
+                break
             self.alloc.reclaim(victim)
             n += 1
+        return n
 
     def run(self, max_windows: int = 100_000) -> tp.Dict[int, Request]:
         """Drive :meth:`step` until queue and slots drain; returns the
@@ -2777,6 +2961,15 @@ class ServingEngine:
             "free_pages": self.alloc.free_pages,
             "cached_pages": self.alloc.cached_pages,
             "cold_reclaims": self.cold_reclaims,
+            # cold-page host spill (spill="on"; all zero otherwise)
+            "spilled_pages": self.spilled_pages,
+            "spill_faultback_pages": self.spill_faultback_pages,
+            "spill_readmissions": self.spill_readmissions,
+            "spill_discards": self.spill_discards,
+            "spill_resident_pages": (
+                len(self._spill_store)
+                if self._spill_store is not None else 0
+            ),
             "prompt_tokens_total": self.prompt_tokens_total,
             "prefill_tokens_saved": self.prompt_tokens_cached,
             "prefill_tokens_computed": self.prefill_tokens_computed,

@@ -333,6 +333,23 @@ class PrefixIndex:
         self._lru: "collections.OrderedDict[int, None]" = (
             collections.OrderedDict()
         )
+        # spilled nodes (host-RAM resident, no HBM page): VIRTUAL ids
+        # <= -2 (the root is -1, real pages are >= 0), in spill order —
+        # oldest-first is the discard order under a host budget. A
+        # spilled node keeps its (parent, chunk) identity, so match()
+        # walks onto and THROUGH it like any resident page and the
+        # engine faults it back (import_pages under a fresh id) before
+        # use. Spill proceeds deepest-first: a page is spill-eligible
+        # once every child is already spilled, so whole cold chains
+        # drain to host tail-to-root and spilled SUBTREES are closed
+        # downward (every child of a spilled node is spilled — a
+        # resident page never chains under a virtual id, because new
+        # children only register under a slot's current node, which
+        # fault-back keeps resident). check() asserts the closure.
+        self._spilled: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()
+        )
+        self._next_spill = -2
 
     def __len__(self) -> int:
         return len(self._meta)
@@ -422,6 +439,99 @@ class PrefixIndex:
                 return page
         return None
 
+    # -- host spill (ServingEngine spill="on") ------------------------------
+
+    @property
+    def spilled_count(self) -> int:
+        return len(self._spilled)
+
+    def is_spilled(self, node: int) -> bool:
+        """True for a virtual spilled-node id (match() can return them
+        as a suffix of the chain, or as the COW source)."""
+        return node in self._spilled
+
+    def coldest_leaf(self) -> tp.Optional[int]:
+        """The LRU cold page with no RESIDENT descendants — the next
+        spill victim, returned without dropping it (the spill path must
+        export the page's contents while the index still maps them).
+        Spilled children don't block: chains drain to host
+        deepest-first, so a reclaimable victim exists while any cold
+        page does."""
+        for page in self._lru:
+            kids = self._children.get(page)
+            if not kids or all(k in self._spilled for k in kids):
+                return page
+        return None
+
+    def _rekey(self, old: int, new: int) -> None:
+        """Move a node between ids, preserving its (parent, chunk)
+        identity, its position under the parent, and its CHILDREN's keys
+        (a child's content key embeds the parent id, so every child
+        re-keys with it)."""
+        parent, chunk = self._meta.pop(old)
+        self._by_key[(parent, chunk)] = new
+        self._meta[new] = (parent, chunk)
+        siblings = self._children.get(parent)
+        if siblings is not None:
+            siblings.discard(old)
+            siblings.add(new)
+        kids = self._children.pop(old, None)
+        if kids:
+            self._children[new] = kids
+            for c in kids:
+                _, cchunk = self._meta[c]
+                del self._by_key[(old, cchunk)]
+                self._by_key[(new, cchunk)] = c
+                self._meta[c] = (new, cchunk)
+
+    def spill(self, page: int) -> int:
+        """Re-key a cold page to a fresh virtual spilled-node id: the
+        (parent, chunk) identity survives — still matchable — while the
+        HBM page id detaches (the caller reclaims it in the allocator
+        and stores the exported payload under the returned id). Only
+        pages whose children are all already spilled are eligible
+        (:meth:`coldest_leaf`), so spilled subtrees stay closed."""
+        assert page in self._meta and page in self._lru, page
+        kids = self._children.get(page)
+        assert not kids or all(k in self._spilled for k in kids), (
+            f"spilling page {page} with resident children"
+        )
+        vid = self._next_spill
+        self._next_spill -= 1
+        self._rekey(page, vid)
+        self._lru.pop(page)
+        self._spilled[vid] = None
+        return vid
+
+    def unspill(self, vid: int, page: int) -> None:
+        """Fault-back re-keying: the spilled node becomes resident page
+        ``page`` (freshly allocated, refcount 1 — the caller imported
+        the stored payload into it). The inverse of :meth:`spill` up to
+        the physical id; any still-spilled children re-key under the
+        new page id with it."""
+        assert vid in self._spilled, vid
+        assert page >= 0 and page not in self._meta, page
+        self._rekey(vid, page)
+        del self._spilled[vid]
+
+    def discard_spilled_oldest(self) -> tp.Optional[int]:
+        """Forget the oldest CHILDLESS spilled node outright (host
+        budget overflow, or a cache clear): returns its virtual id so
+        the caller drops the stored payload, or None when nothing is
+        discardable. Leaf-first like eviction — dropping a mid-chain
+        node would orphan its descendants' keys. True reclaim resumes
+        here: the prefix is simply no longer cached anywhere."""
+        for vid in self._spilled:
+            if self._children.get(vid):
+                continue
+            parent, chunk = self._meta.pop(vid)
+            del self._by_key[(parent, chunk)]
+            self._children.get(parent, set()).discard(vid)
+            self._children.pop(vid, None)
+            del self._spilled[vid]
+            return vid
+        return None
+
     def _drop(self, page: int) -> None:
         parent, chunk = self._meta.pop(page)
         del self._by_key[(parent, chunk)]
@@ -429,9 +539,19 @@ class PrefixIndex:
         self._children.pop(page, None)
         self._lru.pop(page, None)
 
-    def check(self, alloc: tp.Optional[PageAllocator] = None) -> None:
+    def check(
+        self,
+        alloc: tp.Optional[PageAllocator] = None,
+        spill_store: tp.Optional["HostSpillStore"] = None,
+    ) -> None:
         """Structural invariants (property tests call this after every
-        scheduler step)."""
+        scheduler step). With ``spill_store`` the extended spill ledger
+        is checked too: every indexed node is EITHER a resident page
+        (held or cold-cached in ``alloc`` — the classic
+        free+held+cached+quarantined == num_pages identity covers those
+        ids) OR a spilled virtual node with exactly one host-store
+        payload; the two sets are disjoint and spilled subtrees are
+        closed downward (every child of a spilled node is spilled)."""
         assert len(self._by_key) == len(self._meta)
         for page, (parent, chunk) in self._meta.items():
             assert self._by_key[(parent, chunk)] == page
@@ -442,14 +562,81 @@ class PrefixIndex:
                 assert page in self._children[parent]
         for page in self._lru:
             assert page in self._meta
+            assert page >= 0, f"virtual node {page} in the cold LRU"
+        for vid in self._spilled:
+            assert vid <= -2 and vid in self._meta, vid
+            assert all(
+                c in self._spilled for c in self._children.get(vid, ())
+            ), f"spilled node {vid} has resident children"
         if alloc is not None:
             for page in self._meta:
-                # indexed pages are resident: held or cold-cached
+                if page in self._spilled:
+                    continue
+                # indexed resident pages: held or cold-cached
+                assert page >= 0, f"node {page} neither page nor spilled"
                 assert alloc.refcount(page) > 0 or page in alloc._cached
             for page in self._lru:
                 assert alloc.refcount(page) == 0, (
                     f"LRU page {page} still referenced"
                 )
+        if spill_store is not None:
+            assert set(self._spilled) == set(spill_store.nodes()), (
+                "spill store and index disagree on spilled nodes"
+            )
+
+
+class HostSpillStore:
+    """Host-RAM payload store for spilled cold pages (ServingEngine
+    ``spill="on"``): one :func:`export_pages` single-page payload —
+    ``(k, v, sk, sv)`` numpy arrays, all L layers plus the int8 scale
+    planes — per spilled prefix-index node, keyed by the node's virtual
+    id (:meth:`PrefixIndex.spill`). Deliberately the same host-array
+    wire format as the disaggregated page handoff: the spill-out /
+    fault-back round trip is byte-preserving through
+    :func:`import_pages`, which is what keeps spilled-then-revived
+    streams bitwise identical.
+
+    ``budget_pages`` caps host residency — the engine discards
+    oldest-spilled-first past it (true reclaim resumes; the prefix is
+    then cached nowhere). None = unbounded (host RAM is the capacity
+    the feature buys; a 100k-token prompt's KV at int8 is ~2·L·Hkv·C
+    bytes/token, far below typical host memory)."""
+
+    def __init__(self, budget_pages: tp.Optional[int] = None):
+        assert budget_pages is None or budget_pages >= 0, budget_pages
+        self.budget_pages = budget_pages
+        self._store: tp.Dict[int, tp.Tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def __contains__(self, node: int) -> bool:
+        return node in self._store
+
+    def nodes(self) -> tp.Iterable[int]:
+        return self._store.keys()
+
+    def put(self, node: int, payload: tp.Tuple) -> None:
+        assert node not in self._store, f"node {node} spilled twice"
+        self._store[node] = payload
+
+    def pop(self, node: int) -> tp.Tuple:
+        return self._store.pop(node)
+
+    @property
+    def over_budget(self) -> bool:
+        return (
+            self.budget_pages is not None
+            and len(self._store) > self.budget_pages
+        )
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes resident (payloads + scale planes)."""
+        total = 0
+        for payload in self._store.values():
+            total += sum(a.nbytes for a in payload if a is not None)
+        return int(total)
 
 
 def pages_needed(tokens: int, page_size: int) -> int:

@@ -123,6 +123,11 @@ ENGINE_STATS_KEYS: tp.Tuple[str, ...] = (
     "free_pages",
     "cached_pages",
     "cold_reclaims",
+    "spilled_pages",
+    "spill_faultback_pages",
+    "spill_readmissions",
+    "spill_discards",
+    "spill_resident_pages",
     "prompt_tokens_total",
     "prefill_tokens_saved",
     "prefill_tokens_computed",

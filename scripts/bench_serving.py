@@ -144,6 +144,39 @@ def main() -> None:
     ap.add_argument("--prefill_chunk", type=int, default=0,
                     help="chunked-prefill chunk size in tokens "
                     "(0 = monolithic prefill)")
+    ap.add_argument("--prompt_len", type=int, default=0,
+                    help="long-document preset: pin EVERY prompt to "
+                    "exactly this many tokens (overriding --min_prompt/"
+                    "--max_prompt) and widen the model's block_size to "
+                    "fit prompt_len + sys_prompt + max_new — the 100k-"
+                    "token serving shape the sequence-parallel prefill "
+                    "and host-spill rungs measure (0 = off)")
+    ap.add_argument("--prefill_sp", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="sequence-parallel prefill (serving.engine "
+                    "prefill_sp): shard each prefill chunk's query rows "
+                    "across the 'tensor' mesh axis so a chunk's "
+                    "attention+MLP compute drops to 1/tp per chip — "
+                    "streams stay bitwise identical to 'off' (choreo-"
+                    "prover gated). 'auto' = on when tp > 1; decode is "
+                    "untouched either way")
+    ap.add_argument("--spill", choices=("on", "off"), default="off",
+                    help="host-RAM cold-page spill (serving.paged "
+                    "HostSpillStore): under pool pressure, refcount-0 "
+                    "cached pages (+ int8 scale planes) move to host "
+                    "RAM in LRU order instead of being discarded, and "
+                    "fault back byte-exactly on a prefix hit — the "
+                    "prefix cache's capacity extends past HBM. Requires "
+                    "--prefix_cache on")
+    ap.add_argument("--spill_budget_pages", type=int, default=0,
+                    help="cap on host-resident spilled pages (0 = "
+                    "unbounded): past it the oldest childless spilled "
+                    "pages are discarded, never the pool wedged")
+    ap.add_argument("--num_pages", type=int, default=0,
+                    help="KV pool size in pages (0 = slots * pages-per-"
+                    "slot default): the spill-pressure rungs size the "
+                    "pool BELOW the trace's working set so cold pages "
+                    "actually spill")
     ap.add_argument("--sys_prompt_len", type=int, default=0,
                     help="length of a shared system prompt prepended to "
                     "--sys_prompt_frac of requests (0 = independent "
@@ -343,6 +376,10 @@ def main() -> None:
         f" quant={args.quant} kv_quant={args.kv_quant}"
         f" kernel={args.paged_kernel} ls={args.layer_scan}"
         f" tp={args.tp} dp={args.dp_replicas}"
+        f"{f' plen={args.prompt_len}' if args.prompt_len else ''}"
+        f" sp={args.prefill_sp}"
+        f"{' spill' if args.spill == 'on' else ''}"
+        f"{f' pool={args.num_pages}' if args.num_pages else ''}"
         f"{f' disagg={args.disagg}' if args.disagg else ''}"
         f"{' affinity' if args.affinity == 'on' else ''}"
         f"{' faults=' + args.fault_plan if args.fault_plan else ''}"
@@ -429,6 +466,15 @@ def main() -> None:
         cfg = dataclasses.replace(
             get_config("openwebtext").model, attn_impl="auto"
         )
+    if args.prompt_len:
+        # long-document preset: every prompt exactly --prompt_len tokens
+        # (applied AFTER the tiny preset's overrides so it wins), and
+        # the model widened to hold the full context — at 100k tokens
+        # the widened wpe table is the only parameter that grows
+        args.min_prompt = args.max_prompt = args.prompt_len
+        need = args.sys_prompt_len + args.prompt_len + args.max_new
+        if need > cfg.block_size:
+            cfg = dataclasses.replace(cfg, block_size=need)
     assert args.max_prompt + args.max_new <= cfg.block_size, (
         "request mix must fit block_size"
     )
@@ -573,6 +619,10 @@ def main() -> None:
         kv_quant="int8" if args.kv_quant == "on" else None,
         paged_kernel=args.paged_kernel,
         layer_scan=args.layer_scan,
+        prefill_sp=args.prefill_sp,
+        spill=args.spill,
+        spill_budget_pages=args.spill_budget_pages or None,
+        num_pages=args.num_pages or None,
         max_queue=args.max_queue or None,
         # telemetry=True gives each engine/replica its OWN
         # EngineTelemetry (tracing never touches the compiled programs
@@ -634,6 +684,11 @@ def main() -> None:
     shape = shape.replace(
         f"kernel={args.paged_kernel}", f"kernel={engines[0].paged_kernel}"
     )
+    # likewise prefill_sp="auto" resolved against the engine's mesh
+    # (on iff tensor > 1) — the record and shape carry the live mode
+    shape = shape.replace(
+        f"sp={args.prefill_sp}", f"sp={engines[0].prefill_sp}"
+    )
 
     # warmup: compile the decode window + EVERY prefill-chunk bucket the
     # trace can dispatch, on EVERY replica. Full-prompt buckets are not
@@ -666,7 +721,9 @@ def main() -> None:
                      "prompt_tokens_cached", "prefill_tokens_computed",
                      "cold_reclaims", "verify_dispatches", "spec_drafted",
                      "spec_accepted", "cancelled_requests",
-                     "deadline_shed_requests"):
+                     "deadline_shed_requests", "spilled_pages",
+                     "spill_faultback_pages", "spill_readmissions",
+                     "spill_discards"):
             setattr(e, attr, 0)
         # telemetry + histogram reset: the measured trace's timeline and
         # latency distributions must start at zero like its fault_steps
@@ -891,6 +948,24 @@ def main() -> None:
         (lambda q: round(ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))], 1))
         if ttfts else (lambda q: None)
     )
+    # long-prompt TTFT lane: the percentile the SP-prefill rung pair
+    # ladders. With --prompt_len every request is long by construction;
+    # otherwise "long" = the top quartile of the configured prompt band
+    # (+ any shared prefix, which prefills like prompt tokens)
+    long_thresh = args.prompt_len or (
+        args.sys_prompt_len + (3 * args.max_prompt) // 4
+    )
+    ttfts_long = sorted(
+        (r.first_token_time - r.submit_time) * 1e3
+        for r in eng.finished.values()
+        if r.first_token_time is not None
+        and (r.prompt0.size or r.prompt.size) >= long_thresh
+    )
+    ttft_long_p99 = (
+        round(ttfts_long[min(len(ttfts_long) - 1,
+                             int(0.99 * len(ttfts_long)))], 1)
+        if ttfts_long else None
+    )
     # --disagg: TTFT split by the replica class that FINISHED each
     # request (decode-class replicas own every post-handoff first token;
     # prefill-class entries are non-empty only in degraded operation).
@@ -935,6 +1010,22 @@ def main() -> None:
         if st["tokens_generated"] else None
     )
     n_chips = max(1, args.tp * n_replicas)
+    # static SP-prefill compute floor pair (the long-context twin of
+    # the HBM decode floor above): prefilling a mean-length prompt
+    # costs prompt_tokens x flops-per-token at the prompt's mean live
+    # context, compute-bound. The pair BRACKETS the rung pair's
+    # measured TTFT — `floor` is the one-chip compute floor (all row
+    # work replicated), `sp_floor` divides by tp (every per-row
+    # segment sharded over 'tensor'); plain TP already shards the
+    # matmul FLOPs, SP additionally shards the replicated per-token
+    # segments, so the realized prefill lands between the two.
+    prompt_mean = float(np.mean([p.size for p in prompts]))
+    prefill_floor_ms = (
+        prompt_mean * decode_flops_per_token(cfg, prompt_mean / 2.0)
+        / device_peak_flops() * 1e3
+    )
+    sp_on = engines[0].prefill_sp == "on"
+    prefill_sp_floor_ms = prefill_floor_ms / (args.tp if sp_on else 1)
     serve_mfu_v = (
         round(
             (st["tokens_generated"] / wall)
@@ -1104,6 +1195,24 @@ def main() -> None:
         "serve_tok_s": round(st["tokens_generated"] / wall, 1),
         "serve_ttft_p50_ms": pct(0.50),
         "serve_ttft_p99_ms": pct(0.99),
+        # long-context serving (sequence-parallel prefill + host-RAM
+        # cold-page spill): the resolved SP mode, the long-prompt TTFT
+        # lane the sp off/on rung pair ladders, the static prefill
+        # compute floor pair that brackets it (one-chip floor vs the
+        # fully-row-sharded /tp ideal), and the spill counters that
+        # price the host round-trips under pool pressure
+        "serve_prefill_sp": engines[0].prefill_sp,
+        "serve_prompt_len": args.prompt_len or None,
+        "serve_ttft_long_p99": ttft_long_p99,
+        "serve_prefill_floor_ms_static": round(prefill_floor_ms, 4),
+        "serve_prefill_sp_floor_ms_static": round(prefill_sp_floor_ms, 4),
+        "serve_spill": args.spill,
+        "serve_num_pages": engines[0].alloc.num_pages,
+        "serve_spilled_pages": st.get("spilled_pages", 0),
+        "serve_spill_faultback_pages": st.get("spill_faultback_pages", 0),
+        "serve_spill_readmissions": st.get("spill_readmissions", 0),
+        "serve_spill_discards": st.get("spill_discards", 0),
+        "serve_spill_resident_pages": st.get("spill_resident_pages", 0),
         # disaggregated pools + affinity routing (serving.cluster)
         "serve_disagg": args.disagg,
         "serve_affinity": args.affinity,

@@ -232,6 +232,36 @@ run serving_disagg_2p2 python scripts/bench_serving.py --platform=tpu \
 run serving_mono_dp4 python scripts/bench_serving.py --platform=tpu \
   --dp_replicas 4 \
   --out artifacts/bench_serving_mono_dp4.json
+# NEW in PR 19: long-context serving. Rung pair 1 — the 100k-token
+# long-document preset (--prompt_len pins every prompt and widens the
+# model to hold the context) at tp=2, sequence-parallel prefill off vs
+# on over the identical trace: the headline is serve_ttft_long_p99
+# against the serve_prefill_floor_ms_static /
+# serve_prefill_sp_floor_ms_static bracket (Megatron-SP shards the
+# per-token segments TP replicates — embedding, layernorms, residual
+# adds — over 'tensor'; streams are bitwise identical either way, so
+# the TTFT delta is pure replicated-row work + activation traffic).
+# slots=2 keeps the default pool (~7.4 GB of pages, split over the 2
+# chips) inside HBM at this context.
+run serving_longctx_sp_off python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
+  --rate 0.05 --prefill_sp off \
+  --out artifacts/bench_serving_longctx_sp_off.json
+run serving_longctx_sp_on python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
+  --rate 0.05 --prefill_sp on \
+  --out artifacts/bench_serving_longctx_sp_on.json
+# Spill-pressure rung: the same long-document trace against a pool
+# sized BELOW the 2-slot working set (lifetime ~6258 pages/request) —
+# cold chains spill to host RAM in LRU order instead of being
+# discarded. serve_spilled_pages / serve_spill_faultback_pages price
+# the host round-trips, serve_spill_resident_pages the host-side
+# cache the pool gained, and status=ok with zero shed requests is the
+# no-wedge acceptance measured on hardware.
+run serving_longctx_spill python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
+  --rate 0.05 --spill on --num_pages 7000 \
+  --out artifacts/bench_serving_longctx_spill.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -548,6 +548,62 @@ def test_kernel_supported_gates_on_vmem():
                          itemsize=2, groups=24, spec_t=5)
 
 
+def test_kernel_gate_rejects_100k_token_pmax():
+    """Long-context serving: at a 100k-token context the block table
+    spans ``pages_needed(100_000, 16) = 6250`` pages and the kernel's
+    VMEM assembly alone is ~0.9 GB. ``supported()`` must reject from
+    the byte arithmetic — any TP shard fraction, either pool dtype —
+    so ``auto`` can never hand an overflowing kernel to Mosaic; the
+    engine serves long prompts through the XLA gather path instead."""
+    from midgpt_tpu.ops.paged_attn import (
+        VMEM_BUDGET,
+        supported,
+        vmem_bytes,
+    )
+    from midgpt_tpu.serving.paged import pages_needed
+
+    pmax = pages_needed(100_000, 16)
+    assert pmax == 6250
+    w = pmax * 16  # 100_000 resident positions
+    # pin the arithmetic itself, bf16 pool at a 12-head C=64 serving
+    # geometry: K+V assembly at pool dtype, the f32 dequant views on
+    # top, and the x4 f32 score/prob headroom
+    assembly = 2 * 12 * 64 * w * 2 + 2 * 12 * 64 * w * 4
+    scores = 4 * 12 * 1 * 1 * w * 4
+    assert vmem_bytes(pmax, 16, 12, 64, 2, groups=1) == assembly + scores
+    assert assembly + scores == 940_800_000  # ~75x the 12 MiB budget
+    assert not supported(pmax, 16, 12, 64, 2, groups=1)
+    # int8 pool: 1 counted byte/elt, but the kernel still materializes
+    # the two 4-byte f32 views — nowhere near fitting either
+    assert vmem_bytes(pmax, 16, 12, 64, 1, groups=1) == 787_200_000
+    assert not supported(pmax, 16, 12, 64, 1, groups=1)
+    # no realistic TP shard rescues it: even ONE KV head per chip
+    # carries a ~78 MB assembly at this Pmax
+    for hkv in (6, 3, 1):
+        assert vmem_bytes(pmax, 16, hkv, 64, 2, groups=1) > 6 * VMEM_BUDGET
+        assert not supported(pmax, 16, hkv, 64, 2, groups=1)
+
+
+def test_auto_kernel_falls_back_to_xla_at_long_context(monkeypatch):
+    """``auto`` consults the gate with the LONG-context Pmax: with the
+    backend forced to TPU, a 100k-block model still resolves to the
+    XLA gather fallback while the short-block model picks the kernel —
+    the resolution gates on geometry, not platform alone."""
+    import midgpt_tpu.utils.platform as platform
+
+    monkeypatch.setattr(platform, "is_tpu_backend", lambda: True)
+    long_cfg = dataclasses.replace(CFG, block_size=100_000)
+    eng = ServingEngine(
+        _model(long_cfg), slots=1, page_size=16, window=2,
+        num_pages=8, paged_kernel="auto",
+    )
+    assert eng.paged_kernel == "xla"
+    eng_short = ServingEngine(
+        _model(), slots=1, page_size=16, window=2, paged_kernel="auto"
+    )
+    assert eng_short.paged_kernel == "pallas"
+
+
 def test_engine_rejects_unknown_kv_quant():
     with pytest.raises(AssertionError):
         ServingEngine(_model(), slots=1, page_size=8, kv_quant="int4")

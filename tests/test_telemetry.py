@@ -632,6 +632,64 @@ def test_bench_serving_sampled_spec_record_contract(tmp_path):
     assert "topk=20" in rec["serve_shape"]
 
 
+@pytest.mark.slow
+def test_bench_serving_longctx_record_contract(tmp_path):
+    """--prompt_len + --prefill_sp + --spill (the long-context serving
+    rungs): the record must carry the resolved SP mode, the long-prompt
+    TTFT lane, the static SP-prefill floor pair, and the spill
+    counters — the exact surface the r6 sp-off/sp-on pair and the
+    spill-pressure rung consume. The undersized pool must actually
+    spill AND the run must still drain clean (the no-wedge contract)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "rec_longctx.json")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "bench_serving.py"),
+         "--preset", "tiny", "--prompt_len", "64", "--sys_prompt_len", "64",
+         "--requests", "6", "--slots", "1", "--tp", "2",
+         "--prefill_chunk", "32", "--spill", "on", "--num_pages", "10",
+         "--deadline_s", "600", "--out", out],
+        capture_output=True, text=True, env=env, timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.load(open(out))
+    assert rec["status"] == "ok"
+    # prefill_sp="auto" resolved on against the tp=2 mesh, in the
+    # record AND the shape (the rung pair pins off/on explicitly)
+    assert rec["serve_prefill_sp"] == "on"
+    assert "sp=on" in rec["serve_shape"] and "spill" in rec["serve_shape"]
+    assert rec["serve_prompt_len"] == 64
+    # every prompt is long by construction, so the long lane equals the
+    # overall p99 and must be populated
+    assert rec["serve_ttft_long_p99"] is not None
+    assert rec["serve_ttft_long_p99"] == rec["serve_ttft_p99_ms"]
+    # static floor pair: sp divides the per-chip prefill compute by tp
+    assert rec["serve_prefill_floor_ms_static"] > 0
+    assert rec["serve_prefill_sp_floor_ms_static"] == pytest.approx(
+        rec["serve_prefill_floor_ms_static"] / 2, rel=0.5
+    )
+    # the 10-page pool is smaller than the 6-request working set: cold
+    # chains must have spilled to host RAM, and the host store's
+    # cumulative residency may legitimately exceed the pool itself
+    assert rec["serve_num_pages"] == 10
+    assert rec["serve_spilled_pages"] > 0
+    assert rec["serve_spill_resident_pages"] > 0
+    for k in ("serve_spill_faultback_pages", "serve_spill_readmissions",
+              "serve_spill_discards"):
+        assert isinstance(rec[k], int) and rec[k] >= 0, k
+    # no-wedge: everything finished, nothing shed or deferred
+    assert rec["serve_requests_finished"] == rec["serve_requests"]
+    assert rec["serve_shed_requests"] == 0
+    assert rec["serve_error"] is None
+
+
 # ---------------------------------------------------------------------------
 # Shared substrate (PR 15): serving re-exports the midgpt_tpu.telemetry
 # core unchanged, and the Prometheus exporter renders registry

@@ -221,13 +221,17 @@ def test_geometry_keys():
 
 def test_budget_table_covers_all_programs_and_precisions():
     programs = {"decode_window", "prefill_chunk", "verify_program"}
+    # SP prefill only exists on sharded meshes (tensor > 1), so its cells
+    # appear under the tp geometry only.
+    sharded = programs | {"prefill_chunk_sp"}
     for geom in ("single", "replica2,tensor2"):
+        want = sharded if geom == "replica2,tensor2" else programs
         for precision in ("bf16", "int8"):
             have = {
                 p for (p, q, g) in BUDGETS
                 if q == precision and g == geom
             }
-            assert have == programs, (precision, geom, have)
+            assert have == want, (precision, geom, have)
     assert AUDIT_GEOMETRY["config"] == "openwebtext"
 
 
