@@ -1838,6 +1838,16 @@ class ServingEngine:
         full: tp.List[int] = []
         if self.index is not None:
             full, _, _ = self.index.match(ctx[: p - 1])
+            # a match can walk onto HOST-SPILLED nodes (virtual ids, a
+            # suffix of the chain) — no fault-back here: the record
+            # already carries those pages' bytes, so truncate and
+            # import them; _register_pages re-adopts the spilled nodes
+            # through the ordinary re-admission path (payload dropped,
+            # no import dispatch wasted)
+            for i, pg in enumerate(full):
+                if self.index.is_spilled(pg):
+                    full = full[:i]
+                    break
         for pg in full:
             self.alloc.incref(pg)
             self.index.revive(pg)
@@ -2002,7 +2012,9 @@ class ServingEngine:
 
     # -- page accounting with cold-cache spill ------------------------------
 
-    def _try_reserve(self, n: int) -> bool:
+    def _try_reserve(
+        self, n: int, protect: tp.Optional[tp.AbstractSet[int]] = None
+    ) -> bool:
         """Make ``n`` pages allocatable, reclaiming cold cached prefixes
         LRU-leaf-first under pressure; False when the pool genuinely
         cannot produce them. refcount>0 pages are never touched, which is
@@ -2016,7 +2028,12 @@ class ServingEngine:
         virtual (still matchable), and only then does the HBM id return
         to the free list. Past ``spill_budget_pages`` the oldest spilled
         prefixes are forgotten outright — bounded host residency, with
-        plain reclaim as the degradation floor."""
+        plain reclaim as the degradation floor. ``protect`` names
+        spilled vids an in-flight fault-back still needs: budget
+        enforcement skips them (host residency may transiently overshoot
+        until the fault-back pops them itself) rather than dropping a
+        chain node mid-materialization, which would strand a virtual id
+        in the slot's block table."""
         while not self.alloc.can_alloc(n):
             if self.index is None:
                 return False
@@ -2030,8 +2047,12 @@ class ServingEngine:
                 self.alloc.reclaim(victim)
                 self.spilled_pages += 1
                 while self._spill_store.over_budget:
-                    dropped = self.index.discard_spilled_oldest()
-                    assert dropped is not None
+                    dropped = self.index.discard_spilled_oldest(protect)
+                    if dropped is None:
+                        # every discardable node is protected: carry the
+                        # overshoot; the fault-back pops them shortly
+                        assert protect, "over budget with nothing spilled"
+                        break
                     self._spill_store.pop(dropped)
                     self.spill_discards += 1
             else:
@@ -2042,15 +2063,23 @@ class ServingEngine:
                 self.cold_reclaims += 1
         return True
 
-    def _fault_back(self, vid: int) -> tp.Optional[int]:
+    def _fault_back(
+        self,
+        vid: int,
+        protect: tp.Optional[tp.AbstractSet[int]] = None,
+    ) -> tp.Optional[int]:
         """Restore one spilled node to a freshly allocated resident page
         through the jitted page-write path (import_pages — byte-exact,
         so the revived prefix reads back bit-identically). Returns the
         new page id at refcount 1 (the caller's pin), or None when the
         pool cannot produce a page even by spilling others — the caller
-        degrades to a shorter prefix match instead of wedging."""
+        degrades to a shorter prefix match instead of wedging.
+        ``protect`` (which must cover ``vid`` and every other spilled
+        node of the chain being materialized) keeps the reservation's
+        own budget-discard pass from dropping the payloads this
+        fault-back is about to import."""
         assert self._spill_store is not None and self.index is not None
-        if not self._try_reserve(1):
+        if not self._try_reserve(1, protect=protect):
             return None
         [page] = self.alloc.alloc(1)
         k, v, sk, sv = self._spill_store.pop(vid)
@@ -2064,12 +2093,15 @@ class ServingEngine:
         full: tp.List[int],
         cow_src: tp.Optional[int],
         matched: int,
+        protect: tp.Optional[tp.AbstractSet[int]] = None,
     ) -> tp.Tuple[tp.List[int], tp.Optional[int], int, tp.Set[int]]:
         """Materialize any spilled nodes a prefix match walked onto.
         Spilled subtrees are closed downward, so the spilled nodes of a
         matched chain form a SUFFIX of ``full`` (plus possibly the COW
         source, a child of the tail): fault them back in chain order —
         each parent must be resident before its child re-keys under it.
+        ``protect`` must hold the chain's spilled vids so no fault-back's
+        reservation can budget-discard a later node of the same chain.
         Returns the match with virtual ids replaced by resident page
         ids, plus the set of pages already holding their pin (alloc at
         refcount 1 — the pin loop must not incref them again). A failed
@@ -2081,7 +2113,7 @@ class ServingEngine:
         for i, node in enumerate(full):
             if not self.index.is_spilled(node):
                 continue
-            page = self._fault_back(node)
+            page = self._fault_back(node, protect=protect)
             if page is None:
                 # drop the spilled suffix (and the COW source — it
                 # chains under the tail); those tokens just recompute
@@ -2090,7 +2122,7 @@ class ServingEngine:
             full[i] = page
             prepinned.add(page)
         if cow_src is not None and self.index.is_spilled(cow_src):
-            page = self._fault_back(cow_src)
+            page = self._fault_back(cow_src, protect=protect)
             if page is None:
                 return full, None, len(full) * self.page_size, prepinned
             cow_src = page
@@ -2180,24 +2212,43 @@ class ServingEngine:
             # each returning a fresh page already carrying its pin at
             # refcount 1.
             cand = list(full) + ([cow_src] if cow_src is not None else [])
-            pinned = [
-                pg for pg in cand if not self.index.is_spilled(pg)
-            ] if self.index is not None else []
+            spilled_vids = (
+                {pg for pg in cand if self.index.is_spilled(pg)}
+                if self.index is not None else set()
+            )
+            pinned = [pg for pg in cand if pg not in spilled_vids]
             for pg in pinned:
                 self.alloc.incref(pg)
                 self.index.revive(pg)
-            if self._spill_store is not None:
-                full, cow_src, matched, prepinned = (
-                    self._fault_back_matched(full, cow_src, matched)
-                )
-                pinned.extend(sorted(prepinned))
+            # Reserve the WHOLE demand — fresh pages plus one per
+            # spilled chain node — BEFORE any fault-back import: a
+            # head-of-line block must cost zero import_pages dispatches
+            # (pages imported first would unpin straight back to cold
+            # and re-spill on every retry of a blocked large request).
+            # The chain's spilled vids are protected so the
+            # reservation's own budget-discard pass cannot drop the
+            # payloads about to be materialized.
             need = pages_needed(p, self.page_size) - len(full)
-            if not self._try_reserve(need):
+            if not self._try_reserve(
+                need + len(spilled_vids), protect=spilled_vids
+            ):
                 # head-of-line blocks: unpin and wait for pages to free
                 # (deliberately no skip-ahead to a smaller request —
                 # bypassing the selected head would starve large ones)
                 self._release_pages(pinned)
                 break
+            if self._spill_store is not None:
+                full, cow_src, matched, prepinned = self._fault_back_matched(
+                    full, cow_src, matched, protect=spilled_vids
+                )
+                pinned.extend(sorted(prepinned))
+                # a no-op can_alloc check unless a fault-back truncated
+                # the match (impossible after the reservation above, but
+                # the degradation path stays honest)
+                need = pages_needed(p, self.page_size) - len(full)
+                if not self._try_reserve(need):
+                    self._release_pages(pinned)
+                    break
             del self.queue[qi]
             fresh = self.alloc.alloc(need)
             pages = full + fresh

@@ -514,15 +514,25 @@ class PrefixIndex:
         self._rekey(vid, page)
         del self._spilled[vid]
 
-    def discard_spilled_oldest(self) -> tp.Optional[int]:
+    def discard_spilled_oldest(
+        self, protect: tp.Optional[tp.AbstractSet[int]] = None
+    ) -> tp.Optional[int]:
         """Forget the oldest CHILDLESS spilled node outright (host
         budget overflow, or a cache clear): returns its virtual id so
         the caller drops the stored payload, or None when nothing is
         discardable. Leaf-first like eviction — dropping a mid-chain
         node would orphan its descendants' keys. True reclaim resumes
-        here: the prefix is simply no longer cached anywhere."""
+        here: the prefix is simply no longer cached anywhere.
+
+        ``protect`` exempts vids from discard: an in-flight fault-back
+        reserves pages (which may spill victims past the host budget),
+        and budget enforcement must not drop the very chain it is
+        materializing — deepest-first spill makes the matched chain's
+        childless tail precisely the likely oldest entry."""
         for vid in self._spilled:
             if self._children.get(vid):
+                continue
+            if protect is not None and vid in protect:
                 continue
             parent, chunk = self._meta.pop(vid)
             del self._by_key[(parent, chunk)]
@@ -606,6 +616,7 @@ class HostSpillStore:
         assert budget_pages is None or budget_pages >= 0, budget_pages
         self.budget_pages = budget_pages
         self._store: tp.Dict[int, tp.Tuple] = {}
+        self._nbytes = 0
 
     def __len__(self) -> int:
         return len(self._store)
@@ -616,12 +627,19 @@ class HostSpillStore:
     def nodes(self) -> tp.Iterable[int]:
         return self._store.keys()
 
+    @staticmethod
+    def _payload_nbytes(payload: tp.Tuple) -> int:
+        return sum(a.nbytes for a in payload if a is not None)
+
     def put(self, node: int, payload: tp.Tuple) -> None:
         assert node not in self._store, f"node {node} spilled twice"
         self._store[node] = payload
+        self._nbytes += self._payload_nbytes(payload)
 
     def pop(self, node: int) -> tp.Tuple:
-        return self._store.pop(node)
+        payload = self._store.pop(node)
+        self._nbytes -= self._payload_nbytes(payload)
+        return payload
 
     @property
     def over_budget(self) -> bool:
@@ -632,11 +650,11 @@ class HostSpillStore:
 
     @property
     def nbytes(self) -> int:
-        """Host bytes resident (payloads + scale planes)."""
-        total = 0
-        for payload in self._store.values():
-            total += sum(a.nbytes for a in payload if a is not None)
-        return int(total)
+        """Host bytes resident (payloads + scale planes) — a running
+        counter maintained by put/pop, so the per-step telemetry gauge
+        stays O(1) instead of walking every payload array of every
+        spilled page on each sample."""
+        return int(self._nbytes)
 
 
 def pages_needed(tokens: int, page_size: int) -> int:

@@ -252,6 +252,79 @@ def test_eviction_under_pressure_mid_spill(model):
     _check(eng)
 
 
+def test_spill_store_nbytes_counter_and_protected_discard():
+    """HostSpillStore.nbytes is a running counter (put/pop — the
+    telemetry gauge must not walk every payload per sample), and
+    PrefixIndex.discard_spilled_oldest honors the protect set an
+    in-flight fault-back passes."""
+    from midgpt_tpu.serving.paged import HostSpillStore, PrefixIndex
+
+    store = HostSpillStore(budget_pages=1)
+    x = np.arange(8, dtype=np.float32)
+    store.put(-2, (x, x, None, None))
+    store.put(-3, (x, x, x, x))
+    assert store.nbytes == 6 * x.nbytes
+    store.pop(-2)
+    assert store.nbytes == 4 * x.nbytes
+    store.pop(-3)
+    assert store.nbytes == 0
+
+    idx = PrefixIndex(2)
+    p0 = idx.register(PrefixIndex._ROOT, (1, 2), 0)
+    p1 = idx.register(p0, (3, 4), 1)
+    idx.touch_cold(p1)
+    idx.touch_cold(p0)
+    v1 = idx.spill(p1)  # deepest-first: the tail spills oldest
+    v0 = idx.spill(p0)
+    # whole chain protected: nothing is discardable
+    assert idx.discard_spilled_oldest({v0, v1}) is None
+    # tail protected only: v0 still has a (spilled) child -> still None
+    assert idx.discard_spilled_oldest({v1}) is None
+    # unprotected: oldest childless (the tail) goes first
+    assert idx.discard_spilled_oldest() == v1
+    assert idx.discard_spilled_oldest({v1}) == v0
+
+
+def test_budget_discard_protects_inflight_faultback_chain(model):
+    """Regression: a fault-back's own reservation can spill victims
+    past spill_budget_pages, and the budget-discard pass used to drop
+    the oldest CHILDLESS spilled node — deepest-first spill makes that
+    exactly the tail of the chain being materialized. The chain's vids
+    are now protected for the duration (host residency transiently
+    overshoots, then drains as the fault-back pops the payloads);
+    before, the in-flight vid was discarded out from under _fault_back
+    (KeyError at the store pop), or a later chain node silently
+    survived as a negative virtual id in the slot's block table."""
+    ps = 8
+    a = _prompts(1, base_len=20, stride=0, seed0=800)[0]  # 2-node chain
+    b = _prompts(1, base_len=28, stride=0, seed0=801)[0]  # fills the pool
+    kw = dict(page_size=ps, prefill_chunk=8, prefix_cache=True)
+    ref, _ = _run(model, None, [a], 3, **kw)
+    eng = ServingEngine(
+        model, slots=2, page_size=ps, window=4,
+        cache_dtype=jnp.float32, prefill_chunk=8, spill="on",
+        spill_budget_pages=2, num_pages=4,
+    )
+    r1 = eng.submit(a, 3, seed=0)
+    assert list(map(int, eng.run()[r1].tokens)) == ref[0]
+    _force_spill(eng)  # a's 2-node chain -> host, store AT budget
+    assert len(eng._spill_store) == 2
+    r2 = eng.submit(b, 3, seed=1)
+    eng.run()  # b's cold chain occupies all but one HBM page
+    assert eng.alloc.free_pages == 1
+    # the host budget tightens below the spilled chain between
+    # admissions: the next discard pass runs with a's nodes oldest AND
+    # the pool pressured enough that a's own fault-back must spill b
+    eng._spill_store.budget_pages = 1
+    r3 = eng.submit(a, 3, seed=0)
+    fin = eng.run()
+    assert list(map(int, fin[r3].tokens)) == ref[0]
+    st = eng.stats()
+    assert st["spill_faultback_pages"] >= 2  # both chain nodes revived
+    assert st["spill_discards"] > 0  # budget pressed mid-admission
+    _check(eng)
+
+
 def test_cow_on_spilled_parent_page(model):
     """A new request sharing a PARTIAL page with a spilled chain: the
     COW source page faults back from host before it is copied. Chain
