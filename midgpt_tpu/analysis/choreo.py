@@ -116,6 +116,11 @@ class Op:
     # per-input provenance: 'invar' (traces to a program entry through
     # pass-through ops only), 'const', 'lit', or 'var' (computed)
     in_origins: tp.Tuple[str, ...]
+    # static shape metadata for the few prims where a positional fact
+    # IS the contract: a ``slice``'s start_indices (so the banded-
+    # accumulation-order extractor can read which probability columns a
+    # PV partial consumed). None for everything else.
+    meta: tp.Optional[tp.Tuple[int, ...]] = None
 
 
 class FlatGraph:
@@ -266,6 +271,11 @@ def flatten_jaxpr(closed) -> FlatGraph:
                 vid, _ = fresh(out_origin)
                 env_[ov] = (vid, out_origin)
                 rec_outs.append(vid)
+            meta = None
+            if nm == "slice":
+                si = eqn.params.get("start_indices")
+                if si is not None:
+                    meta = tuple(int(x) for x in si)
             ops.append(Op(
                 idx=len(ops),
                 prim=nm,
@@ -274,6 +284,7 @@ def flatten_jaxpr(closed) -> FlatGraph:
                 in_ids=tuple(vid for vid, _ in ins),
                 out_ids=tuple(rec_outs),
                 in_origins=tuple(origin for _, origin in ins),
+                meta=meta,
             ))
 
     walk(jaxpr, env)
@@ -562,7 +573,10 @@ def softmax_signature(
     if denom_div is not None:
         frontier = [denom_div.out_ids[0]]
         hops = 0
-        while frontier and hops < 64:
+        # the banded kernels (PR 20) slice the probability row once per
+        # page band — up to MAX_BANDS slices plus their view chains —
+        # so the walk needs far more than the pre-banding ~4 hops
+        while frontier and hops < 256:
             hops += 1
             vid = frontier.pop()
             for c in graph.consumers.get(vid, []):
@@ -592,6 +606,98 @@ def softmax_signature(
         probs_dtype=frozenset(probs_dtype),
         pv_contracts=frozenset(pv),
     )
+
+
+def band_accumulation_order(
+    graph: FlatGraph, exp_op: Op
+) -> tp.Optional[tp.Tuple[int, ...]]:
+    """The PV accumulation ORDER around one attention softmax: the
+    tuple of last-dim probability-row offsets of the fold's add-tree
+    leaves, in the order the fold sums them.
+
+    The banded paged kernels (PR 20, ops.paged_attn) split the PV
+    contraction into per-page-band partials — each one a slice of the
+    normalized probability row times its band's values — and fold them
+    with ``banded_fold`` in pinned ascending-band order; the XLA
+    reference runs the identical chunked reduction. f32 addition is
+    not associative, so the fold's LEAF ORDER is a bitwise contract
+    the dtype-level softmax signature cannot see. This extractor reads
+    it straight off the jaxpr: walk forward from the normalized probs
+    (the softmax's denominator ``div``) carrying the cumulative
+    last-dim slice offset, mark every ``mul`` -> ``reduce_sum``
+    consumer as one PV partial at its offset, then linearize the add
+    tree that folds the partials — the left-to-right leaf sequence IS
+    the summation order. The recent/self partial appears as the final
+    leaf at offset W (its probability slice starts past the pool
+    columns), so a correct fold reads strictly ascending.
+
+    Returns None when the softmax's PV is not a probs-slice fold — the
+    prefill chunk and the naive reference contract their probs with an
+    einsum (``dot_general``), which has no fold and no order to pin —
+    or when fewer than two partials exist. The prover's banded-order
+    clause applies only to decode and verify, where None is itself a
+    violation (their PV has had the mul/reduce_sum shape since PR 6)."""
+    denom = None
+    for c in graph.consumers.get(exp_op.out_ids[0], []):
+        if c.prim == "div":
+            denom = c
+            break
+        if c.prim == "reduce_sum":
+            for c2 in graph.consumers.get(c.out_ids[0], []):
+                if c2.prim == "div":
+                    denom = c2
+                    break
+    if denom is None:
+        return None
+    # forward walk from the normalized probs, carrying the cumulative
+    # last-dim offset; a mul -> reduce_sum consumer is one PV partial
+    partials: tp.Dict[int, int] = {}
+    frontier: tp.List[tp.Tuple[int, int]] = [(denom.out_ids[0], 0)]
+    hops = 0
+    while frontier and hops < 1024:
+        hops += 1
+        vid, off = frontier.pop()
+        for c in graph.consumers.get(vid, []):
+            if c.prim == "slice":
+                noff = off + (c.meta[-1] if c.meta else 0)
+                frontier.extend((o, noff) for o in c.out_ids)
+            elif c.prim in _PASSTHRU:
+                frontier.extend((o, off) for o in c.out_ids)
+            elif c.prim == "mul":
+                for c2 in graph.consumers.get(c.out_ids[0], []):
+                    if c2.prim == "reduce_sum":
+                        partials[c2.out_ids[0]] = off
+    if len(partials) < 2:
+        return None
+    # find the fold's root by climbing add-consumers from one partial
+    # (the fold is a left spine: each add's output feeds the next)
+    cur = next(iter(partials))
+    climbed = False
+    for _ in range(len(partials) + 8):
+        nxt = next(
+            (c for c in graph.consumers.get(cur, []) if c.prim == "add"),
+            None,
+        )
+        if nxt is None:
+            break
+        climbed = True
+        cur = nxt.out_ids[0]
+    if not climbed:
+        return None
+
+    def leaves(vid: int, depth: int = 0) -> tp.List[int]:
+        op = graph.producer.get(vid)
+        if op is not None and op.prim == "add" and depth < 200:
+            return (
+                leaves(op.in_ids[0], depth + 1)
+                + leaves(op.in_ids[1], depth + 1)
+            )
+        return [vid]
+
+    lv = leaves(cur)
+    if any(v not in partials for v in lv):
+        return None
+    return tuple(partials[v] for v in lv)
 
 
 # ---------------------------------------------------------------------------
@@ -675,6 +781,10 @@ class ProgramChoreography:
     # the f32(s8-codes) * scale multiply of an int8 KV pool is present
     # (in the kernel body or the gathered view)
     kv_dequant: bool = False
+    # the PV fold's summation order as probability-row offsets (see
+    # band_accumulation_order); None for einsum-PV programs (prefill/
+    # naive) where no fold exists
+    band_order: tp.Optional[tp.Tuple[int, ...]] = None
 
 
 def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
@@ -709,6 +819,16 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
         kv_deq = kv_deq or any(
             _has_kv_dequant(flatten_jaxpr(k)) for k in kernels
         )
+        # the banded-accumulation order is a property of the KERNEL
+        # BODY's PV fold (the outer trace sees only the contract node)
+        kgraph = flatten_jaxpr(kernels[0])
+        kexps = [
+            op for op in kgraph.ops
+            if op.prim == "exp" and op.out_dtypes[0] in _FLOAT_DTYPES
+        ]
+        band_order = (
+            band_accumulation_order(kgraph, kexps[0]) if kexps else None
+        )
     else:
         exps = [
             op for op in graph.ops
@@ -722,6 +842,7 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
                 f"{name}: softmax signatures differ between layers:\n"
                 f"  {sig.describe()}\n  {s2.describe()}"
             )
+        band_order = band_accumulation_order(graph, exps[0])
     # lm head: the LAST weight projection in program order, plus its
     # epilogue (a following multiply whose other operand is an entry
     # parameter — the QuantLinear per-channel scale)
@@ -746,6 +867,7 @@ def extract_choreography(name: str, closed_jaxpr) -> ProgramChoreography:
         lm_head_epilogue=epilogue,
         kernelized=bool(kernels),
         kv_dequant=kv_deq,
+        band_order=band_order,
     )
 
 
@@ -789,6 +911,11 @@ class ChoreoReport:
                     "lm_head_epilogue": p.lm_head_epilogue,
                     "kernelized": p.kernelized,
                     "kv_dequant": p.kv_dequant,
+                    "band_order": (
+                        list(p.band_order)
+                        if p.band_order is not None
+                        else None
+                    ),
                 }
                 for p in self.programs
             },
@@ -927,6 +1054,30 @@ def prove_choreography(
             not any(deq.values()),
             f"kv_dequant {deq}",
         ))
+    # banded PV accumulation order (PR 20): the decode and verify PV
+    # folds — kernel body and banded XLA reference alike — must sum
+    # their page-band partials in pinned ASCENDING-band order, with the
+    # recent/self partial last (its probability slice starts past the
+    # pool columns, so a correct fold reads strictly ascending), and
+    # the two programs must agree exactly. f32 addition is not
+    # associative: a reordered fold is a bitwise drift no dtype check
+    # sees (the fault injection in tests/test_choreo.py reverses
+    # ops.paged_attn._BAND_FOLD_ORDER and must fail exactly this
+    # clause). The prefill chunk and naive reference contract their
+    # probs with an einsum — no fold exists, band_accumulation_order
+    # returns None for them, and they are exempt by construction; for
+    # decode/verify a None is itself a violation (their PV lost the
+    # shape the extractor pins).
+    def _ascending(t: tp.Optional[tp.Tuple[int, ...]]) -> bool:
+        return t is not None and all(a < b for a, b in zip(t, t[1:]))
+
+    shared.append((
+        "banded PV accumulation runs in pinned ascending-band order",
+        _ascending(decode.band_order) and _ascending(verify.band_order)
+        and decode.band_order == verify.band_order,
+        f"band_order decode={decode.band_order} "
+        f"verify={verify.band_order}",
+    ))
     # decode and verify must agree on WHERE the attention runs (both in
     # the kernel or both in XLA) — a half-kernelized pair could pass the
     # per-program checks while running two different arithmetic stacks

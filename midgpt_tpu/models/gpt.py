@@ -657,10 +657,42 @@ class Attention:
             probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)
             p_pool = probs[..., : s_pool.shape[-1]]
             p_rec = probs[..., s_pool.shape[-1]:]
-            o_pool = jnp.sum(
-                p_pool[:, :, :, None, :] * cv[:, :, None].astype(jnp.float32),
-                axis=-1,
-            )  # [S, Hkv, G, C]
+            # PV accumulation in the banded kernel's pinned
+            # ascending-band order (ops.paged_attn.banded_fold, same
+            # band plan): f32 addition is not associative, so matching
+            # the kernel's chunked reduction order IS what keeps
+            # kernel == XLA bitwise at long contexts. One band (every
+            # small geometry) folds to exactly the pre-banding single
+            # reduce — the trace is unchanged there.
+            from midgpt_tpu.ops.paged_attn import (
+                banded_fold, resolved_band_pages,
+            )
+            w_pool = s_pool.shape[-1]
+            bw = resolved_band_pages(
+                bt.shape[1], ps, c, jnp.dtype(pool_k.dtype).itemsize
+            ) * ps
+            if bw >= w_pool:
+                o_pool = jnp.sum(
+                    p_pool[:, :, :, None, :]
+                    * cv[:, :, None].astype(jnp.float32),
+                    axis=-1,
+                )  # [S, Hkv, G, C]
+            else:
+                # plain lax slices (NOT mixed None+slice indexing,
+                # which lowers to a gather and hides the band start
+                # from the choreo prover's order extractor)
+                o_pool = banded_fold([
+                    jnp.sum(
+                        jax.lax.slice_in_dim(
+                            p_pool, lo, lo + bw, axis=-1
+                        )[:, :, :, None, :]
+                        * jax.lax.slice_in_dim(
+                            cv, lo, lo + bw, axis=-1
+                        )[:, :, None].astype(jnp.float32),
+                        axis=-1,
+                    )
+                    for lo in range(0, w_pool, bw)
+                ])  # [S, Hkv, G, C]
             o_rec = jnp.sum(
                 p_rec[..., None] * rvl[:, :, None].astype(jnp.float32),
                 axis=-2,
@@ -917,11 +949,37 @@ class Attention:
             probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
             p_pool = probs[..., : s_pool.shape[-1]]
             p_self = probs[..., s_pool.shape[-1]:]
-            o_pool = jnp.sum(
-                p_pool[:, :, :, :, None, :]
-                * cv[:, :, None, None].astype(jnp.float32),
-                axis=-1,
-            )  # [S, Hkv, G, T, C]
+            # PV fold in the banded kernel's pinned ascending-band
+            # order — same contract (and same band plan) as
+            # decode_paged_at's XLA branch; one band degenerates to
+            # the pre-banding single reduce, trace unchanged.
+            from midgpt_tpu.ops.paged_attn import (
+                banded_fold, resolved_band_pages,
+            )
+            w_pool = s_pool.shape[-1]
+            bw = resolved_band_pages(
+                bt.shape[1], ps, c, jnp.dtype(pool_k.dtype).itemsize
+            ) * ps
+            if bw >= w_pool:
+                o_pool = jnp.sum(
+                    p_pool[:, :, :, :, None, :]
+                    * cv[:, :, None, None].astype(jnp.float32),
+                    axis=-1,
+                )  # [S, Hkv, G, T, C]
+            else:
+                # plain lax slices — see decode_paged_at's banded fold
+                o_pool = banded_fold([
+                    jnp.sum(
+                        jax.lax.slice_in_dim(
+                            p_pool, lo, lo + bw, axis=-1
+                        )[:, :, :, :, None, :]
+                        * jax.lax.slice_in_dim(
+                            cv, lo, lo + bw, axis=-1
+                        )[:, :, None, None].astype(jnp.float32),
+                        axis=-1,
+                    )
+                    for lo in range(0, w_pool, bw)
+                ])  # [S, Hkv, G, T, C]
             o_self = jnp.sum(
                 p_self[..., None] * vc[:, :, None, None].astype(jnp.float32),
                 axis=-2,

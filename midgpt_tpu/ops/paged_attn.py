@@ -9,11 +9,25 @@ the HBM-bound decode step pays the K+V stream ~3x. These kernels walk
 each slot's block table IN-KERNEL over its ragged ``pooled_len`` (the
 "Ragged Paged Attention" formulation, PAPERS.md — the TPU kernel
 purpose-built for exactly this paged layout): every resident page is
-DMA'd from HBM into a VMEM assembly scratch exactly once, nothing
-page-shaped ever lands back in HBM, and the whole joint softmax +
-weighted-value contraction runs out of VMEM. Per decode step the pool
-traffic drops to the roofline minimum — each live K and V byte crosses
-HBM once.
+DMA'd from HBM into VMEM exactly once, nothing page-shaped ever lands
+back in HBM, and the whole joint softmax + weighted-value contraction
+runs out of VMEM. Per decode step the pool traffic drops to the
+roofline minimum — each live K and V byte crosses HBM once.
+
+BANDED STREAMING (PR 20): the walk no longer assembles every resident
+page at once. The grid runs over (slot x KV head), and each program
+streams its head's pages in ascending PAGE BANDS of ``band_pages``
+pages, double-buffered at ``DMA_DEPTH``: while band *i* computes, band
+*i+1*'s DMA is already in flight. VMEM residency is
+O(DMA_DEPTH x band x page_size) per pass — independent of Pmax — so
+``supported()`` now says yes at 100k-token contexts (6250 pages @
+ps16) where the old whole-pool assembly needed ~940 MB. Band sizing:
+``band_pages`` picks the largest divisor of Pmax whose per-band
+working set (K+V band buffers at DMA depth, plus the f32 dequant/
+upcast views a sub-f32 pool materializes) fits ``BAND_VMEM_BUDGET``,
+capped at ``MAX_BANDS`` bands (the band loop is Python-unrolled into
+the trace). No divisor fits -> ``band_pages`` returns None, the gate
+reports the honest single-band cost, and ``auto`` falls back to XLA.
 
 EXACTNESS CONTRACT (the reason this kernel looks the way it does): the
 serving suite's landing gate is greedy token-identity against the XLA
@@ -22,30 +36,38 @@ path, and the repo has twice shipped attention variants that drifted by
 (PR 4/PR 5, see analysis.choreo). A classic flash-style online-softmax
 accumulator — running max with ``exp(m_old - m_new)`` rescales folded
 into the accumulator — can NEVER be bitwise against the XLA joint
-softmax: the rescale multiplies are extra roundings. So the walk here
-is "online" in the streaming sense but defers normalization: pages
-stream once into the VMEM assembly, the running mask/length bookkeeping
-rides the walk, and the softmax itself is ONE flat f32 pass over the
-VMEM-resident scores — the exact op sequence (same primitives, same
-reduce extents, mask added before the in-softmax ``/ sqrt(C)`` scale,
-f32 probs through the PV sums) as ``decode_paged_at``. The result is
-BITWISE equal to the XLA gather path (asserted by
-tests/test_paged_attn.py down to the f32 pattern), so the kernel slots
-under the existing token-identity matrix instead of weakening it to a
-tolerance. The VMEM cost is the assembly scratch, O(context) instead of
-O(1) — at serving block sizes (<= 8K tokens) that is a few MB against
-the 16 MB budget; a context long enough to break that is ring/offload
-territory, not a paged decode batch.
+softmax: the rescale multiplies are extra roundings. Banding does not
+change that decision (the PR 9 design decision stands): the f32 score
+row for the FULL context is small (~0.4 MB per head-group at 100k) and
+stays VMEM-resident, so normalization remains ONE flat f32 softmax.
+Concretely the kernel makes two streaming passes per program:
+
+  pass 1 (K): each band's scores are per-column sums over C — banding
+    is invisible to them bitwise — concatenated with the recent/self
+    scores into the one full score row, then the single joint softmax;
+  pass 2 (V): each band's PV partial summed over its band width,
+    folded in PINNED ASCENDING-BAND ORDER (``banded_fold``). The fold
+    order is the ONE place banding touches f32 summation order, so
+    the XLA reference path runs the IDENTICAL chunked reduction
+    (models.gpt banded PV fold, same ``banded_fold``, same band plan)
+    and the kernel stays BITWISE equal to the XLA path (asserted by
+    tests/test_paged_attn.py down to the f32 pattern) across decode +
+    verify, MHA + GQA, ragged lengths, both pool precisions, and the
+    greedy/sampled token-identity matrix. The accumulation order is
+    machine-checked: analysis.choreo's banded-accumulation-order
+    clause extracts the fold's add-tree leaf order from the jaxpr and
+    fails if any band lands out of ascending order.
 
 INT8 KV (``scale_k``/``scale_v`` given): the pool payload is int8 with
 one f32 power-of-two scale per (page, KV-head) plane
 (serving.paged — the KV analogue of quant.py's po2 exactness contract).
-Dequantization happens in-kernel at the VMEM boundary:
-``f32(q) * scale`` with ``|q| <= 127`` and a po2 scale is EXACT, so the
-kernel is bitwise against dequantize-then-attend — an int8 pool behaves
-like a bf16 pool whose values happen to lie on the page grid, and the
-greedy token streams stay invariant across every engine feature
-combination (unit-tested at the page level).
+Dequantization happens in-kernel at the VMEM boundary, per band:
+``f32(q) * scale`` with ``|q| <= 127`` and a po2 scale is EXACT and
+elementwise, so the band slice of the dequantized stream equals the
+dequantized band slice — an int8 pool behaves like a bf16 pool whose
+values happen to lie on the page grid, and the greedy token streams
+stay invariant across every engine feature combination (unit-tested at
+the page level).
 
 Dtype choreography (machine-checked: analysis.choreo extracts the
 kernel body's softmax signature and proves it equal to the decode
@@ -90,99 +112,191 @@ def _interpret_default() -> bool:
     return not is_tpu_backend()
 
 
-# Conservative fit budget for the VMEM assembly + score scratch, out of
+# Conservative fit budget for the kernel's total VMEM working set
+# (band stream buffers + the full-context f32 score/prob rows), out of
 # ~16 MB/core. Module-level so the long-context gate tests can pin the
-# rejection arithmetic against the same constant the ``auto`` path uses.
+# acceptance arithmetic against the same constant the ``auto`` path
+# uses.
 VMEM_BUDGET = 12 * 1024 * 1024
+
+# Double-buffer depth of the banded page stream: band i's compute
+# overlaps band i+1's DMA. Depth 2 is the classic ping-pong (the
+# Pallas double-buffering idiom); the band working-set arithmetic in
+# ``_band_bytes`` scales with it, so raising the depth automatically
+# shrinks the auto-sized band.
+DMA_DEPTH = 2
+
+# Per-pass band working-set budget: DMA_DEPTH band buffers for K and V
+# at pool dtype, plus the f32 dequant/upcast views of the compute
+# band. 2 MB keeps the stream buffers a small fraction of VMEM_BUDGET
+# so the full-context f32 score row — the flat-softmax contract's
+# residency cost — gets the rest.
+BAND_VMEM_BUDGET = 2 * 1024 * 1024
+
+# The band loop is Python-unrolled into the kernel trace (that is what
+# keeps the choreography extractable and the softmax flat), so cap the
+# band count: a geometry that would need more bands than this is
+# rejected by the gate rather than traced into an enormous program.
+MAX_BANDS = 64
+
+# Test hook: force the band plan (pages per band) regardless of the
+# VMEM arithmetic, so small-geometry tests can exercise genuinely
+# multi-banded kernels. Must divide Pmax. None = auto-size.
+_FORCE_BAND_PAGES: tp.Optional[int] = None
+
+# Fault-injection hook for the choreography prover's banded-
+# accumulation-order clause: "ascending" is the pinned contract; the
+# choreo fault test flips this to "descending" and the prover must
+# fail EXACTLY the band-order clause (both the kernel and the XLA
+# reference fold through banded_fold, so bitwise kernel==XLA survives
+# the flip and no other clause goes red).
+_BAND_FOLD_ORDER = "ascending"
+
+
+def banded_fold(parts: tp.Sequence[Array]) -> Array:
+    """Fold the per-band PV partials in the PINNED ascending-band
+    order (a left fold: ((o_0 + o_1) + o_2) + ...). f32 addition is
+    not associative, so this order IS the bitwise contract between the
+    banded kernel and the banded XLA reference — both call exactly
+    this function. The recent/self partial is added AFTER the fold,
+    outside it (it is not a page band)."""
+    seq = list(parts)
+    if _BAND_FOLD_ORDER != "ascending":
+        seq = seq[::-1]
+    out = seq[0]
+    for p in seq[1:]:
+        out = out + p
+    return out
+
+
+def _band_bytes(band_pages_: int, page_size: int, c: int,
+                itemsize: int) -> int:
+    """VMEM bytes of ONE streaming pass's band working set at this
+    band size: K and V band buffers (2x) at DMA_DEPTH slots each, pool
+    dtype, plus — for a sub-f32 pool (bf16, int8) — the f32
+    dequant/upcast views of the K and V compute bands that
+    ``_dequant_band`` materializes."""
+    bw = band_pages_ * page_size
+    total = 2 * DMA_DEPTH * c * bw * itemsize
+    if itemsize < 4:
+        total += 2 * c * bw * 4
+    return total
+
+
+def band_pages(pmax: int, page_size: int, c: int,
+               itemsize: int) -> tp.Optional[int]:
+    """Auto-size the page band: the LARGEST divisor of ``pmax`` whose
+    band working set fits ``BAND_VMEM_BUDGET``, with at most
+    ``MAX_BANDS`` bands (the band loop is unrolled into the trace).
+    Returns None when no divisor satisfies both — e.g. a head dim so
+    large even one page overflows the band budget, or a
+    pathologically-factored Pmax whose only fitting divisors need too
+    many bands — and the gate then reports the honest single-band
+    (whole-table) cost, which is exactly the pre-banding arithmetic.
+    The plan depends ONLY on (pmax, page_size, c, itemsize): never on
+    head counts, groups, or spec length, so the fold order — and with
+    it the f32 bit pattern — is invariant across TP degree and
+    spec on/off."""
+    if _FORCE_BAND_PAGES is not None:
+        assert pmax % _FORCE_BAND_PAGES == 0, (
+            f"_FORCE_BAND_PAGES={_FORCE_BAND_PAGES} must divide "
+            f"pmax={pmax}"
+        )
+        return _FORCE_BAND_PAGES
+    best = None
+    for d in range(1, pmax + 1):
+        if pmax % d:
+            continue
+        if _band_bytes(d, page_size, c, itemsize) <= BAND_VMEM_BUDGET:
+            best = d
+    if best is None or pmax // best > MAX_BANDS:
+        return None
+    return best
+
+
+def resolved_band_pages(pmax: int, page_size: int, c: int,
+                        itemsize: int) -> int:
+    """The band plan the kernels AND the XLA reference fold actually
+    use: the auto-sized (or test-forced) band, falling back to one
+    whole-table band when no plan fits — the honest degenerate case
+    the gate keeps off the ``auto`` path but a forced kernel can still
+    run. Shared between ops.paged_attn and models.gpt so the two PV
+    fold orders can never diverge."""
+    bp = band_pages(pmax, page_size, c, itemsize)
+    if bp is None:
+        bp = pmax
+    assert pmax % bp == 0
+    return bp
 
 
 def vmem_bytes(pmax: int, page_size: int, hkv: int, c: int,
                itemsize: int, groups: int = 8, spec_t: int = 1) -> int:
-    """Worst-case VMEM demand of the kernel at this geometry, in bytes:
-    the K + V assembly scratch at pool dtype, the f32 dequant/upcast
-    views ``_dequant_view`` materializes on top of a sub-f32 pool, and
-    f32 score/prob headroom ([Hkv, G, T, W] x4 for scores + probs + exp
-    temps). Exposed separately from :func:`supported` so the
-    long-context tests can pin the arithmetic itself — at 100k-token
-    Pmax the assembly alone is tens of MB and the gate must reject from
-    the byte count, not from a tuned special case."""
+    """Worst-case VMEM demand of the BANDED kernel at this geometry,
+    in bytes: one streaming pass's band working set (``_band_bytes``
+    at the auto-sized band — K + V band buffers at DMA_DEPTH, plus the
+    f32 dequant/upcast views for a sub-f32 pool), the full-context f32
+    score + prob rows ([G, T, W] x2 — the flat-softmax residency
+    cost), and the int8 pool's per-page f32 scale planes. ``hkv`` is
+    accepted for signature stability but no longer enters the
+    arithmetic: the grid runs over (slot x KV head), so per-program
+    residency is head-count-free — that grid axis is half of what
+    makes 100k contexts fit. When ``band_pages`` finds no plan the
+    arithmetic falls back to the single whole-table band, i.e. the
+    honest pre-banding cost, and the gate rejects from the byte count
+    exactly as before. Exposed separately from :func:`supported` so
+    the long-context tests can pin the arithmetic itself."""
+    del hkv  # grid over KV heads: residency is per-head already
+    bp = band_pages(pmax, page_size, c, itemsize)
+    if bp is None:
+        bp = pmax
     w = pmax * page_size
-    assembly = 2 * hkv * c * w * itemsize
-    if itemsize < 4:
-        # f32 ck/cv views of the K and V assemblies
-        assembly += 2 * hkv * c * w * 4
-    # [Hkv, G, T, W] f32, x4 headroom (scores + probs + exp temps)
-    scores = 4 * hkv * max(1, groups) * max(1, spec_t) * w * 4
-    return assembly + scores
+    # [G, T, W] f32 score row + prob row, both resident across pass 2
+    scores = 2 * max(1, groups) * max(1, spec_t) * w * 4
+    total = _band_bytes(bp, page_size, c, itemsize) + scores
+    if itemsize == 1:
+        # int8 pool: the gathered per-page scale planes ride along as
+        # [Pmax]-shaped f32 VMEM blocks (K and V)
+        total += 2 * pmax * 4
+    return total
 
 
 def supported(pmax: int, page_size: int, hkv: int, c: int,
               itemsize: int, groups: int = 8, spec_t: int = 1) -> bool:
-    """Does the assembly scratch for this geometry fit comfortably in
-    VMEM? K + V assembly at pool dtype plus f32 score/prob headroom
-    (``groups`` = query heads per KV head — the [Hkv, G, W] score and
-    prob buffers scale with it; ``spec_t`` = candidate rows per slot in
-    the verify kernel, whose score/prob buffers are [Hkv, G, T, W] —
-    pass ``speculate + 1`` when speculation is on), against a
-    conservative 12 MB budget (of ~16 MB/core). A sub-f32 pool
-    (bf16, and worst int8 — 1 counted byte vs 4 materialized) also pays
-    for the f32 dequant/upcast copies of BOTH assemblies that
-    ``_dequant_view`` builds on top of the pool-dtype scratch; omitting
-    them let ``auto`` pick the kernel on geometries whose real VMEM
-    demand overflowed Mosaic (code-review finding)."""
+    """Does the banded working set for this geometry fit comfortably
+    in VMEM? Band stream buffers + full-context f32 score/prob rows
+    (``groups`` = query heads per KV head — the [G, W] score and prob
+    rows scale with it; ``spec_t`` = candidate rows per slot in the
+    verify kernel, whose rows are [G, T, W] — pass ``speculate + 1``
+    when speculation is on), against a conservative 12 MB budget (of
+    ~16 MB/core). A sub-f32 pool (bf16, and worst int8 — 1 counted
+    byte vs 4 materialized) also pays for the f32 dequant/upcast
+    copies of the K and V compute bands that ``_dequant_band`` builds
+    on top of the pool-dtype stream; omitting them let ``auto`` pick
+    the kernel on geometries whose real VMEM demand overflowed Mosaic
+    (code-review finding, PR 9 — the accounting survives banding,
+    per-band). Because the band working set is O(band) rather than
+    O(Pmax), this now returns True at 100k-token Pmax (6250 pages @
+    ps16) for both bf16 and int8 pools — the gate that used to reject
+    from a ~940 MB whole-pool assembly."""
     return vmem_bytes(
         pmax, page_size, hkv, c, itemsize, groups=groups, spec_t=spec_t
     ) <= VMEM_BUDGET
 
 
-def _dequant_view(buf: Array, scales_ref, hkv: int, pmax: int,
+def _dequant_band(buf: Array, sc: tp.Optional[Array], b: int, bp: int,
                   ps: int) -> Array:
-    """VMEM assembly [Hkv, C, W] -> f32 stream values. For an int8 pool
-    the per-page scale plane broadcasts to per-position columns and the
-    dequant multiply is exact (|q| <= 127, po2 scale — quant.py's
-    epilogue contract, applied to the KV stream)."""
-    w = pmax * ps
-    if scales_ref is None:
+    """One band's VMEM buffer [C, BW] -> f32 stream values. For an
+    int8 pool the band's slice of the per-page scale vector broadcasts
+    to per-position columns and the dequant multiply is exact
+    (|q| <= 127, po2 scale — quant.py's epilogue contract, applied to
+    the KV stream). Dequantization is elementwise, so the band slice
+    of the dequantized stream is bitwise the dequantized band slice —
+    banding cannot perturb it."""
+    if sc is None:
         return buf.astype(jnp.float32)
-    sc = scales_ref[0]  # [Pmax, Hkv] f32
-    scw = jnp.transpose(sc, (1, 0))[:, :, None]  # [Hkv, Pmax, 1]
-    scw = jnp.broadcast_to(scw, (hkv, pmax, ps)).reshape(hkv, 1, w)
+    sc_b = sc[b * bp:(b + 1) * bp]  # [BP] f32, static band slice
+    scw = jnp.broadcast_to(sc_b[:, None], (bp, ps)).reshape(1, bp * ps)
     return buf.astype(jnp.float32) * scw
-
-
-def _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
-                    sem, ps: int):
-    """The in-kernel block-table walk: zero the assembly scratch, then
-    DMA each resident page of slot ``s`` (K and V, this layer) from HBM
-    into its [.., i*PS:(i+1)*PS] assembly columns — each page crosses
-    HBM exactly once. Page ids are clipped like the XLA path's
-    ``mode="clip"`` gather (pads beyond ``npages`` are never walked;
-    the clip is defense against a corrupt table, and clipped garbage is
-    erased by the -inf mask before the softmax). The zero-fill is what
-    makes un-walked columns safe: masked scores become exactly
-    ``0 + (-inf)`` and masked value columns contribute exactly
-    ``0.0 * 0.0`` — finite, so no NaN can leak through ``0 * garbage``."""
-    np_total = pk_ref.shape[1]
-    kbuf[...] = jnp.zeros_like(kbuf)
-    vbuf[...] = jnp.zeros_like(vbuf)
-
-    def body(i, carry):
-        page = jnp.clip(bt_ref[s, i], 0, np_total - 1)
-        cpk = pltpu.make_async_copy(
-            pk_ref.at[layer, page], kbuf.at[:, :, pl.ds(i * ps, ps)],
-            sem.at[0],
-        )
-        cpk.start()
-        cpv = pltpu.make_async_copy(
-            pv_ref.at[layer, page], vbuf.at[:, :, pl.ds(i * ps, ps)],
-            sem.at[1],
-        )
-        cpv.start()
-        cpk.wait()
-        cpv.wait()
-        return carry
-
-    jax.lax.fori_loop(0, npages, body, 0)
 
 
 def _decode_kernel(
@@ -191,67 +305,134 @@ def _decode_kernel(
     len_ref,     # [S] int32 — pooled_len
     r_ref,       # [1] int32 — step index within the window
     # inputs
-    q_ref,       # [1, Hkv, G, C] block — this slot's post-rope queries
-    rk_ref,      # [1, Hkv, R, C] block — recent K rows (this layer)
-    rv_ref,      # [1, Hkv, R, C] block
-    sk_ref,      # [1, Pmax, Hkv] f32 block or None (int8 pool only)
+    q_ref,       # [1, 1, G, C] block — this (slot, KV head)'s queries
+    rk_ref,      # [1, 1, R, C] block — recent K rows (this layer/head)
+    rv_ref,      # [1, 1, R, C] block
+    sk_ref,      # [1, 1, Pmax] f32 block or None (int8 pool only)
     sv_ref,
     pk_ref,      # [L, NP, Hkv, C, PS] pool K, HBM/ANY
     pv_ref,
     # outputs / scratch
-    out_ref,     # [1, Hkv, G, C] block
-    kbuf,        # VMEM [Hkv, C, Pmax*PS] pool dtype
-    vbuf,
-    sem,
+    out_ref,     # [1, 1, G, C] block
+    kband,       # VMEM [DMA_DEPTH, C, BP*PS] pool dtype
+    vband,
+    sem,         # DMA semaphores [2, DMA_DEPTH] (K row 0, V row 1)
     *,
     layer: int,
     ps: int,
+    nb: int,
 ):
     s = pl.program_id(0)
-    hkv, c, w = kbuf.shape
-    pmax = w // ps
+    j = pl.program_id(1)
+    _, c, bw = kband.shape
+    bp = bw // ps
+    w = nb * bw
     rr = rk_ref.shape[2]
+    np_total = pk_ref.shape[1]
     npages = pl.cdiv(len_ref[s], ps)
-    _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
-                    sem, ps)
-    ck = _dequant_view(kbuf[...], sk_ref, hkv, pmax, ps)  # [Hkv, C, W] f32
-    cv = _dequant_view(vbuf[...], sv_ref, hkv, pmax, ps)
-    qs = q_ref[0]  # [Hkv, G, C]
-    # masks: identical values to the XLA path's (0 / -inf f32)
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)[0]
-    mask_pool = jnp.where(idx < len_ref[s], 0.0, -jnp.inf).astype(
-        jnp.float32
-    )
+
+    def _band_dma(pref, buf, row, b, start):
+        """Start (or wait for) band ``b``'s page DMAs into buffer slot
+        b % DMA_DEPTH: each live page of the band crosses HBM exactly
+        once, into its [.., i*PS:(i+1)*PS] band columns. Page ids are
+        clipped like the XLA path's ``mode="clip"`` gather (pads
+        beyond ``npages`` are never walked; the clip is defense
+        against a corrupt table, and clipped garbage is erased by the
+        -inf mask before the softmax). The zero-fill on start is what
+        makes un-DMA'd columns safe: masked scores become exactly
+        ``0 + (-inf)`` and masked value columns contribute exactly
+        ``0.0 * 0.0`` — finite, so no NaN can leak through
+        ``0 * garbage``. Waits re-construct the same descriptors and
+        pair one wait per started page on the band's semaphore."""
+        slot = b % DMA_DEPTH
+        lo = b * bp
+        live = jnp.clip(npages - lo, 0, bp)
+        if start:
+            buf[slot] = jnp.zeros_like(buf[slot])
+
+        def body(i, carry):
+            page = jnp.clip(bt_ref[s, lo + i], 0, np_total - 1)
+            cp = pltpu.make_async_copy(
+                pref.at[layer, page, j],
+                buf.at[slot, :, pl.ds(i * ps, ps)],
+                sem.at[row, slot],
+            )
+            if start:
+                cp.start()
+            else:
+                cp.wait()
+            return carry
+
+        jax.lax.fori_loop(0, live, body, 0)
+
+    qs = q_ref[0, 0]  # [G, C]
+    sc_k = None if sk_ref is None else sk_ref[0, 0]  # [Pmax] f32
+    sc_v = None if sv_ref is None else sv_ref[0, 0]
+    # PASS 1 (K): stream the bands, double-buffered — band b's scores
+    # compute while band b+1's DMA is in flight. Each band's scores
+    # are per-column sums over C, so banding is bitwise-invisible to
+    # them; the masked parts concatenate into the ONE full-context f32
+    # score row (the flat-softmax contract — no online rescaling).
+    for d in range(min(DMA_DEPTH - 1, nb)):
+        _band_dma(pk_ref, kband, 0, d, start=True)
+    parts = []
+    for b in range(nb):
+        nxt = b + DMA_DEPTH - 1
+        if nxt < nb:
+            _band_dma(pk_ref, kband, 0, nxt, start=True)
+        _band_dma(pk_ref, kband, 0, b, start=False)
+        ck_b = _dequant_band(kband[b % DMA_DEPTH], sc_k, b, bp, ps)
+        # the decode choreography, op for op (decode_paged_at): f32
+        # upcast-multiplies, f32 accumulation, mask BEFORE the
+        # in-softmax scale
+        s_b = jnp.sum(
+            qs[:, :, None].astype(SCORE_ACC_DTYPE)
+            * ck_b[None].astype(SCORE_ACC_DTYPE),
+            axis=-2, dtype=SCORE_ACC_DTYPE,
+        )  # [G, BW]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, bw), 1)[0] + b * bw
+        mask_b = jnp.where(idx < len_ref[s], 0.0, -jnp.inf).astype(
+            jnp.float32
+        )
+        parts.append(s_b + mask_b)
+    rkl = rk_ref[0, 0]  # [R, C]
+    rvl = rv_ref[0, 0]
+    s_rec = jnp.sum(
+        qs[:, None, :].astype(SCORE_ACC_DTYPE)
+        * rkl[None].astype(SCORE_ACC_DTYPE),
+        axis=-1, dtype=SCORE_ACC_DTYPE,
+    )  # [G, R]
     ridx = jax.lax.broadcasted_iota(jnp.int32, (1, rr), 1)[0]
     mask_rec = jnp.where(ridx <= r_ref[0], 0.0, -jnp.inf).astype(
         jnp.float32
     )
-    # the decode choreography, op for op (decode_paged_at): f32
-    # upcast-multiplies, f32 accumulation, mask BEFORE the in-softmax
-    # scale, one joint exp, f32 probs through the PV sums
-    qcw = qs[:, :, :, None]  # [Hkv, G, C, 1]
-    s_pool = jnp.sum(
-        qcw.astype(SCORE_ACC_DTYPE) * ck[:, None].astype(SCORE_ACC_DTYPE),
-        axis=-2, dtype=SCORE_ACC_DTYPE,
-    )  # [Hkv, G, W]
-    rkl = rk_ref[0]  # [Hkv, R, C]
-    rvl = rv_ref[0]
-    s_rec = jnp.sum(
-        qs[:, :, None, :].astype(SCORE_ACC_DTYPE)
-        * rkl[:, None].astype(SCORE_ACC_DTYPE),
-        axis=-1, dtype=SCORE_ACC_DTYPE,
-    )  # [Hkv, G, R]
-    s_all = jnp.concatenate([s_pool + mask_pool, s_rec + mask_rec], axis=-1)
-    probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
-    p_pool = probs[..., :w]
-    p_rec = probs[..., w:]
-    o_pool = jnp.sum(
-        p_pool[:, :, None, :] * cv[:, None].astype(jnp.float32), axis=-1
-    )  # [Hkv, G, C]
+    s_all = jnp.concatenate(parts + [s_rec + mask_rec], axis=-1)
+    probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32, joint
+    # PASS 2 (V): stream the bands again (each V byte still crosses
+    # HBM exactly once), each band's PV partial summed over its band
+    # width, folded in PINNED ascending-band order — the one place
+    # banding touches f32 summation order, matched bitwise by the XLA
+    # reference's banded_fold.
+    for d in range(min(DMA_DEPTH - 1, nb)):
+        _band_dma(pv_ref, vband, 1, d, start=True)
+    opars = []
+    for b in range(nb):
+        nxt = b + DMA_DEPTH - 1
+        if nxt < nb:
+            _band_dma(pv_ref, vband, 1, nxt, start=True)
+        _band_dma(pv_ref, vband, 1, b, start=False)
+        cv_b = _dequant_band(vband[b % DMA_DEPTH], sc_v, b, bp, ps)
+        p_b = probs[:, b * bw:(b + 1) * bw]  # [G, BW] f32
+        opars.append(
+            jnp.sum(p_b[:, None, :] * cv_b[None].astype(jnp.float32),
+                    axis=-1)
+        )  # [G, C]
+    o_pool = banded_fold(opars)
+    p_rec = probs[:, w:]
     o_rec = jnp.sum(
-        p_rec[..., None] * rvl[:, None].astype(jnp.float32), axis=-2
+        p_rec[..., None] * rvl[None].astype(jnp.float32), axis=-2
     )
-    out_ref[0] = (o_pool + o_rec).astype(out_ref.dtype)
+    out_ref[0, 0] = (o_pool + o_rec).astype(out_ref.dtype)
 
 
 def paged_decode_attention(
@@ -268,35 +449,44 @@ def paged_decode_attention(
     scale_v: tp.Optional[Array] = None,  # per-page scales (int8 pool)
     interpret: tp.Optional[bool] = None,
 ) -> Array:  # [S, Hkv, G, C] compute dtype
-    """One decode step's paged attention for all slots: pool part read
-    by an in-kernel ragged block-table walk, recent part from the
-    window's write buffer, one joint softmax — bitwise the XLA gather
-    path's result without the gathered HBM intermediate."""
+    """One decode step's paged attention for all slots: pool part
+    streamed by the banded in-kernel ragged block-table walk, recent
+    part from the window's write buffer, one joint softmax — bitwise
+    the (banded-fold) XLA gather path's result without the gathered
+    HBM intermediate, at O(band) VMEM."""
     s, hkv, g, c = q.shape
     l, np_total, _, _, ps = pool_k.shape
     pmax = bt.shape[1]
     quant = scale_k is not None
     if interpret is None:
         interpret = _interpret_default()
-    kern = functools.partial(_decode_kernel, layer=layer, ps=ps)
+    bp = resolved_band_pages(pmax, ps, c, jnp.dtype(pool_k.dtype).itemsize)
+    nb = pmax // bp
+    kern = functools.partial(_decode_kernel, layer=layer, ps=ps, nb=nb)
     if not quant:
         kern = _drop_scale_refs(kern, n_scalar=3)
     in_specs = [
-        pl.BlockSpec((1, hkv, g, c), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec((1, 1, g, c), lambda i, j, *_: (i, j, 0, 0)),
         pl.BlockSpec(
-            (1, hkv, rk_l.shape[2], c), lambda i, *_: (i, 0, 0, 0)
+            (1, 1, rk_l.shape[2], c), lambda i, j, *_: (i, j, 0, 0)
         ),
         pl.BlockSpec(
-            (1, hkv, rk_l.shape[2], c), lambda i, *_: (i, 0, 0, 0)
+            (1, 1, rk_l.shape[2], c), lambda i, j, *_: (i, j, 0, 0)
         ),
     ]
     args = [q, rk_l, rv_l]
     if quant:
         in_specs += [
-            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, pmax), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, 1, pmax), lambda i, j, *_: (i, j, 0)),
         ]
-        args += [scale_k, scale_v]
+        # [S, Pmax, Hkv] -> [S, Hkv, Pmax]: a head's scale vector as a
+        # contiguous last-dim block (a [.., Pmax, 1] block would pad
+        # its unit lane dim out to the tile width — ~3 MB at 100k Pmax)
+        args += [
+            jnp.transpose(scale_k, (0, 2, 1)),
+            jnp.transpose(scale_v, (0, 2, 1)),
+        ]
     in_specs += [
         pl.BlockSpec(memory_space=pltpu.ANY),
         pl.BlockSpec(memory_space=pltpu.ANY),
@@ -304,13 +494,15 @@ def paged_decode_attention(
     args += [pool_k, pool_v]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(s,),
+        grid=(s, hkv),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, hkv, g, c), lambda i, *_: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, g, c), lambda i, j, *_: (i, j, 0, 0)
+        ),
         scratch_shapes=[
-            pltpu.VMEM((hkv, c, pmax * ps), pool_k.dtype),
-            pltpu.VMEM((hkv, c, pmax * ps), pool_v.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((DMA_DEPTH, c, bp * ps), pool_k.dtype),
+            pltpu.VMEM((DMA_DEPTH, c, bp * ps), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, DMA_DEPTH)),
         ],
     )
     return pl.pallas_call(
@@ -341,68 +533,115 @@ def _verify_kernel(
     bt_ref,      # [S, Pmax] int32
     start_ref,   # [S] int32 — per-slot write watermark
     # inputs
-    q_ref,       # [1, Hkv, G, T, C] block
-    kc_ref,      # [1, Hkv, T, C] block — cache-rounded self K rows
-    vc_ref,      # [1, Hkv, T, C] block
-    sk_ref,      # [1, Pmax, Hkv] f32 block or None
+    q_ref,       # [1, 1, G, T, C] block
+    kc_ref,      # [1, 1, T, C] block — cache-rounded self K rows
+    vc_ref,      # [1, 1, T, C] block
+    sk_ref,      # [1, 1, Pmax] f32 block or None
     sv_ref,
     pk_ref,      # [L, NP, Hkv, C, PS] pool, HBM/ANY
     pv_ref,
-    out_ref,     # [1, Hkv, G, T, C] block
-    kbuf,
-    vbuf,
+    out_ref,     # [1, 1, G, T, C] block
+    kband,       # VMEM [DMA_DEPTH, C, BP*PS] pool dtype
+    vband,
     sem,
     *,
     layer: int,
     ps: int,
+    nb: int,
 ):
     s = pl.program_id(0)
-    hkv, c, w = kbuf.shape
-    pmax = w // ps
+    j = pl.program_id(1)
+    _, c, bw = kband.shape
+    bp = bw // ps
+    w = nb * bw
     t = kc_ref.shape[2]
+    np_total = pk_ref.shape[1]
     npages = pl.cdiv(start_ref[s], ps)
-    _assemble_pages(pk_ref, pv_ref, bt_ref, s, npages, layer, kbuf, vbuf,
-                    sem, ps)
-    ck = _dequant_view(kbuf[...], sk_ref, hkv, pmax, ps)  # [Hkv, C, W]
-    cv = _dequant_view(vbuf[...], sv_ref, hkv, pmax, ps)
-    qs = q_ref[0]  # [Hkv, G, T, C]
-    kc = kc_ref[0]  # [Hkv, T, C]
-    vc = vc_ref[0]
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)[0]
-    mask_pool = jnp.where(idx < start_ref[s], 0.0, -jnp.inf).astype(
-        jnp.float32
-    )
+
+    def _band_dma(pref, buf, row, b, start):
+        # identical walk to _decode_kernel's _band_dma (see its
+        # docstring for the clip/zero-fill contract)
+        slot = b % DMA_DEPTH
+        lo = b * bp
+        live = jnp.clip(npages - lo, 0, bp)
+        if start:
+            buf[slot] = jnp.zeros_like(buf[slot])
+
+        def body(i, carry):
+            page = jnp.clip(bt_ref[s, lo + i], 0, np_total - 1)
+            cp = pltpu.make_async_copy(
+                pref.at[layer, page, j],
+                buf.at[slot, :, pl.ds(i * ps, ps)],
+                sem.at[row, slot],
+            )
+            if start:
+                cp.start()
+            else:
+                cp.wait()
+            return carry
+
+        jax.lax.fori_loop(0, live, body, 0)
+
+    qs = q_ref[0, 0]  # [G, T, C]
+    kc = kc_ref[0, 0]  # [T, C]
+    vc = vc_ref[0, 0]
+    sc_k = None if sk_ref is None else sk_ref[0, 0]  # [Pmax] f32
+    sc_v = None if sv_ref is None else sv_ref[0, 0]
+    # the decode choreography over T candidate rows (verify_paged_at
+    # op for op): f32 upcast-multiplies, f32 accumulation, one joint
+    # exp, f32 probs through the PV sums — banded exactly like
+    # _decode_kernel (pass 1 K scores, flat softmax, pass 2 V fold)
+    for d in range(min(DMA_DEPTH - 1, nb)):
+        _band_dma(pk_ref, kband, 0, d, start=True)
+    parts = []
+    for b in range(nb):
+        nxt = b + DMA_DEPTH - 1
+        if nxt < nb:
+            _band_dma(pk_ref, kband, 0, nxt, start=True)
+        _band_dma(pk_ref, kband, 0, b, start=False)
+        ck_b = _dequant_band(kband[b % DMA_DEPTH], sc_k, b, bp, ps)
+        s_b = jnp.sum(
+            qs[..., :, None].astype(SCORE_ACC_DTYPE)
+            * ck_b[None, None].astype(SCORE_ACC_DTYPE),
+            axis=-2, dtype=SCORE_ACC_DTYPE,
+        )  # [G, T, BW]
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, bw), 1)[0] + b * bw
+        mask_b = jnp.where(idx < start_ref[s], 0.0, -jnp.inf).astype(
+            jnp.float32
+        )
+        parts.append(s_b + mask_b)
+    s_self = jnp.sum(
+        qs[:, :, None, :].astype(SCORE_ACC_DTYPE)
+        * kc[None, None].astype(SCORE_ACC_DTYPE),
+        axis=-1, dtype=SCORE_ACC_DTYPE,
+    )  # [G, T, T]
     rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
     mask_self = jnp.where(cols <= rows, 0.0, -jnp.inf).astype(jnp.float32)
-    # the decode choreography over T candidate rows (verify_paged_at op
-    # for op): f32 upcast-multiplies, f32 accumulation, one joint exp,
-    # f32 probs through the PV sums
-    s_pool = jnp.sum(
-        qs[..., :, None].astype(SCORE_ACC_DTYPE)
-        * ck[:, None, None].astype(SCORE_ACC_DTYPE),
-        axis=-2, dtype=SCORE_ACC_DTYPE,
-    )  # [Hkv, G, T, W]
-    s_self = jnp.sum(
-        qs[:, :, :, None, :].astype(SCORE_ACC_DTYPE)
-        * kc[:, None, None].astype(SCORE_ACC_DTYPE),
-        axis=-1, dtype=SCORE_ACC_DTYPE,
-    )  # [Hkv, G, T, T]
-    s_all = jnp.concatenate(
-        [s_pool + mask_pool, s_self + mask_self], axis=-1
-    )
+    s_all = jnp.concatenate(parts + [s_self + mask_self], axis=-1)
     probs = jax.nn.softmax(s_all / math.sqrt(c), axis=-1)  # f32
-    p_pool = probs[..., :w]
-    p_self = probs[..., w:]
-    o_pool = jnp.sum(
-        p_pool[:, :, :, None, :] * cv[:, None, None].astype(jnp.float32),
-        axis=-1,
-    )  # [Hkv, G, T, C]
+    for d in range(min(DMA_DEPTH - 1, nb)):
+        _band_dma(pv_ref, vband, 1, d, start=True)
+    opars = []
+    for b in range(nb):
+        nxt = b + DMA_DEPTH - 1
+        if nxt < nb:
+            _band_dma(pv_ref, vband, 1, nxt, start=True)
+        _band_dma(pv_ref, vband, 1, b, start=False)
+        cv_b = _dequant_band(vband[b % DMA_DEPTH], sc_v, b, bp, ps)
+        p_b = probs[:, :, b * bw:(b + 1) * bw]  # [G, T, BW] f32
+        opars.append(
+            jnp.sum(
+                p_b[:, :, None, :] * cv_b[None, None].astype(jnp.float32),
+                axis=-1,
+            )
+        )  # [G, T, C]
+    o_pool = banded_fold(opars)
+    p_self = probs[:, :, w:]
     o_self = jnp.sum(
-        p_self[..., None] * vc[:, None, None].astype(jnp.float32),
-        axis=-2,
-    )
-    out_ref[0] = (o_pool + o_self).astype(out_ref.dtype)
+        p_self[..., None] * vc[None, None].astype(jnp.float32), axis=-2
+    )  # [G, T, C]
+    out_ref[0, 0] = (o_pool + o_self).astype(out_ref.dtype)
 
 
 def paged_verify_attention(
@@ -421,7 +660,7 @@ def paged_verify_attention(
     """Speculative-verify paged attention: all T candidate rows of every
     slot against its ragged resident pages plus themselves (causal), one
     joint softmax, decode choreography — the kernel twin of
-    ``Attention.verify_paged_at`` with the same in-kernel walk as
+    ``Attention.verify_paged_at`` with the same banded in-kernel walk as
     :func:`paged_decode_attention`."""
     s, hkv, g, t, c = q.shape
     l, np_total, _, _, ps = pool_k.shape
@@ -429,21 +668,26 @@ def paged_verify_attention(
     quant = scale_k is not None
     if interpret is None:
         interpret = _interpret_default()
-    kern = functools.partial(_verify_kernel, layer=layer, ps=ps)
+    bp = resolved_band_pages(pmax, ps, c, jnp.dtype(pool_k.dtype).itemsize)
+    nb = pmax // bp
+    kern = functools.partial(_verify_kernel, layer=layer, ps=ps, nb=nb)
     if not quant:
         kern = _drop_scale_refs(kern, n_scalar=2)
     in_specs = [
-        pl.BlockSpec((1, hkv, g, t, c), lambda i, *_: (i, 0, 0, 0, 0)),
-        pl.BlockSpec((1, hkv, t, c), lambda i, *_: (i, 0, 0, 0)),
-        pl.BlockSpec((1, hkv, t, c), lambda i, *_: (i, 0, 0, 0)),
+        pl.BlockSpec((1, 1, g, t, c), lambda i, j, *_: (i, j, 0, 0, 0)),
+        pl.BlockSpec((1, 1, t, c), lambda i, j, *_: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, t, c), lambda i, j, *_: (i, j, 0, 0)),
     ]
     args = [q, kc, vc]
     if quant:
         in_specs += [
-            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, pmax, hkv), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, pmax), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, 1, pmax), lambda i, j, *_: (i, j, 0)),
         ]
-        args += [scale_k, scale_v]
+        args += [
+            jnp.transpose(scale_k, (0, 2, 1)),
+            jnp.transpose(scale_v, (0, 2, 1)),
+        ]
     in_specs += [
         pl.BlockSpec(memory_space=pltpu.ANY),
         pl.BlockSpec(memory_space=pltpu.ANY),
@@ -451,15 +695,15 @@ def paged_verify_attention(
     args += [pool_k, pool_v]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(s,),
+        grid=(s, hkv),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, hkv, g, t, c), lambda i, *_: (i, 0, 0, 0, 0)
+            (1, 1, g, t, c), lambda i, j, *_: (i, j, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((hkv, c, pmax * ps), pool_k.dtype),
-            pltpu.VMEM((hkv, c, pmax * ps), pool_v.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((DMA_DEPTH, c, bp * ps), pool_k.dtype),
+            pltpu.VMEM((DMA_DEPTH, c, bp * ps), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, DMA_DEPTH)),
         ],
     )
     return pl.pallas_call(

@@ -1014,6 +1014,7 @@ _ENGINE_COUNTERS = (
     "cold_reclaims",
     "spilled_pages",
     "spill_faultback_pages",
+    "spill_prefetch_pages",
     "spill_readmissions",
     "spill_discards",
     "verify_dispatches",
@@ -1125,6 +1126,7 @@ class ServingEngine:
         prefill_sp: str = "auto",
         spill: str = "off",
         spill_budget_pages: tp.Optional[int] = None,
+        spill_prefetch: str = "on",
         mesh=None,
         clock: tp.Callable[[], float] = time.monotonic,
         max_queue: tp.Optional[int] = None,
@@ -1366,6 +1368,14 @@ class ServingEngine:
             "pages ever spill"
         )
         assert spill_budget_pages is None or spill_budget_pages >= 0
+        # prefetch-on-queue (spill="on" only): each scheduler step
+        # probes the wait-queue head's prompt against the prefix index
+        # and fault-backs its matched SPILLED chain nodes BEFORE
+        # admission, in ONE batched import_pages call (bounded per
+        # step). "off" degrades to pure fault-on-match at admission —
+        # same stream bytes, more import dispatches on the TTFT path.
+        assert spill_prefetch in ("on", "off"), spill_prefetch
+        self.spill_prefetch = spill_prefetch
         self.spill = spill
         self._spill_store = (
             HostSpillStore(budget_pages=spill_budget_pages)
@@ -2129,6 +2139,71 @@ class ServingEngine:
             prepinned.add(page)
         return full, cow_src, matched, prepinned
 
+    # pages faulted back per scheduler step by prefetch-on-queue; one
+    # batched import_pages dispatch covers the whole bound, so raising
+    # it trades step-time import bytes against extra queue-wait steps
+    _SPILL_PREFETCH_BOUND = 8
+
+    def _spill_prefetch(self) -> None:
+        """Prefetch-on-queue: probe the wait-queue HEAD's prompt against
+        the prefix index and fault back the matched chain's spilled
+        nodes BEFORE admission — one batched :func:`import_pages` call
+        per step (bounded), instead of one import dispatch per node at
+        admit time. Prefetched pages park cold-resident at refcount 0,
+        so the admission that follows pins them through the ordinary
+        resident-chain path; byte-exact imports keep the stream bitwise
+        identical to fault-on-match, only the dispatch count on the
+        TTFT path drops.
+
+        Discipline mirrors :meth:`_admit`: the chain's RESIDENT nodes
+        are pinned first so the reservation can never spill a parent out
+        from under a child about to unspill, and the chain's spilled
+        vids ride the reservation's protect-set (the PR 19 fix) so the
+        budget-discard pass cannot drop the payloads being prefetched.
+        A failed reservation degrades to fault-on-match at admission —
+        never an error."""
+        if (
+            self._spill_store is None
+            or self.spill_prefetch != "on"
+            or not self.queue
+            or self.index is None
+            or not len(self._spill_store)
+        ):
+            return
+        req = self.queue[self._select_queued()]
+        p = int(req.prompt.size)
+        full, cow_src, _ = self.index.match(req.prompt[: p - 1])
+        cand = list(full) + ([cow_src] if cow_src is not None else [])
+        spilled = [pg for pg in cand if self.index.is_spilled(pg)]
+        if not spilled:
+            return
+        # chain order: full's spilled nodes are a suffix of the chain,
+        # the COW source chains under its tail — truncating to a PREFIX
+        # of that list keeps every parent ahead of its child
+        vids = spilled[: self._SPILL_PREFETCH_BOUND]
+        pinned = [pg for pg in cand if pg not in set(spilled)]
+        for pg in pinned:
+            self.alloc.incref(pg)
+            self.index.revive(pg)
+        if not self._try_reserve(len(vids), protect=set(vids)):
+            self._release_pages(pinned)
+            return
+        pages = self.alloc.alloc(len(vids))
+        payloads = [self._spill_store.pop(v) for v in vids]
+        k = np.concatenate([pl[0] for pl in payloads], axis=1)
+        v = np.concatenate([pl[1] for pl in payloads], axis=1)
+        sk = sv = None
+        if payloads[0][2] is not None:
+            sk = np.concatenate([pl[2] for pl in payloads], axis=1)
+            sv = np.concatenate([pl[3] for pl in payloads], axis=1)
+        self.pool = import_pages(self.pool, pages, k, v, sk, sv)
+        for vid, page in zip(vids, pages):
+            self.index.unspill(vid, page)
+        self.spill_faultback_pages += len(pages)
+        self.spill_prefetch_pages += len(pages)
+        # decref to 0 → cold-resident and matchable: admission pins them
+        self._release_pages(pinned + list(pages))
+
     def _release_pages(self, pages: tp.Iterable[int]) -> None:
         """Decref a request's pages: indexed ones retire to the cold
         prefix cache (still matchable), private ones free outright."""
@@ -2782,6 +2857,7 @@ class ServingEngine:
         if self.parked and not self.queue and not self._active_slots():
             # nothing else can free pages — parked work must retry now
             self._unpark()
+        self._spill_prefetch()
         self._admit()
         self._run_prefills()
         decoding = self._decoding_slots()
@@ -3015,6 +3091,7 @@ class ServingEngine:
             # cold-page host spill (spill="on"; all zero otherwise)
             "spilled_pages": self.spilled_pages,
             "spill_faultback_pages": self.spill_faultback_pages,
+            "spill_prefetch_pages": self.spill_prefetch_pages,
             "spill_readmissions": self.spill_readmissions,
             "spill_discards": self.spill_discards,
             "spill_resident_pages": (

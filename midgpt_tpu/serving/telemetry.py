@@ -125,6 +125,7 @@ ENGINE_STATS_KEYS: tp.Tuple[str, ...] = (
     "cold_reclaims",
     "spilled_pages",
     "spill_faultback_pages",
+    "spill_prefetch_pages",
     "spill_readmissions",
     "spill_discards",
     "spill_resident_pages",

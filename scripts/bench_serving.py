@@ -722,8 +722,8 @@ def main() -> None:
                      "cold_reclaims", "verify_dispatches", "spec_drafted",
                      "spec_accepted", "cancelled_requests",
                      "deadline_shed_requests", "spilled_pages",
-                     "spill_faultback_pages", "spill_readmissions",
-                     "spill_discards"):
+                     "spill_faultback_pages", "spill_prefetch_pages",
+                     "spill_readmissions", "spill_discards"):
             setattr(e, attr, 0)
         # telemetry + histogram reset: the measured trace's timeline and
         # latency distributions must start at zero like its fault_steps
@@ -1157,7 +1157,10 @@ def main() -> None:
         "serve_comms_collective_count": comms_count,
         "serve_quant": args.quant,
         "serve_kv_quant": args.kv_quant,
-        "serve_paged_kernel": engines[0].paged_kernel,
+        # requested vs resolved: "auto" resolves post-supported(), and a
+        # long-context row claiming pallas must not hide an XLA fallback
+        "serve_paged_kernel": args.paged_kernel,
+        "serve_paged_kernel_resolved": engines[0].paged_kernel,
         "serve_layer_scan": args.layer_scan,
         "serve_static_launches_per_window": disp.get("launches_per_window"),
         "serve_static_inlined_layer_bodies": disp.get(
@@ -1210,6 +1213,7 @@ def main() -> None:
         "serve_num_pages": engines[0].alloc.num_pages,
         "serve_spilled_pages": st.get("spilled_pages", 0),
         "serve_spill_faultback_pages": st.get("spill_faultback_pages", 0),
+        "serve_spill_prefetch_pages": st.get("spill_prefetch_pages", 0),
         "serve_spill_readmissions": st.get("spill_readmissions", 0),
         "serve_spill_discards": st.get("spill_discards", 0),
         "serve_spill_resident_pages": st.get("spill_resident_pages", 0),

@@ -262,6 +262,30 @@ run serving_longctx_spill python scripts/bench_serving.py --platform=tpu \
   --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
   --rate 0.05 --spill on --num_pages 7000 \
   --out artifacts/bench_serving_longctx_spill.json
+# NEW in PR 20: long-context DECODE. Rung pair 2 — the same 100k-token
+# preset made decode-heavy (long generations, int8 weights + int8 KV:
+# the production precision whose thin pool stream makes the gather
+# path's 3x KV re-read starkest) at tp=2, XLA gather fallback vs the
+# banded Pallas kernel over the identical trace. The headline is
+# serve_ms_per_tok against serve_floor_ms_per_tok_static: the banded
+# kernel streams each resident K/V byte ONCE per pass where the
+# gather path pays the [S, Pmax, Hkv, C, PS] HBM intermediate ~3x per
+# step (PERF.md PR 20 arithmetic) — streams are bitwise identical, so
+# the delta is pure traffic. serve_paged_kernel vs
+# serve_paged_kernel_resolved proves the pallas row really ran the
+# kernel (auto would resolve to it too; pinning both legs keeps the
+# pair self-interpreting), and the timelines show the decode-lane
+# dispatch cadence the kernel tightens.
+run serving_longctx_decode_xla python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
+  --rate 0.05 --min_new 256 --max_new 512 --quant on --kv_quant on \
+  --paged_kernel xla --timeline_dir artifacts/r6/tl_longctx_decode_xla \
+  --out artifacts/bench_serving_longctx_decode_xla.json
+run serving_longctx_decode_pallas python scripts/bench_serving.py --platform=tpu \
+  --tp 2 --prompt_len 100000 --prefill_chunk 512 --requests 4 --slots 2 \
+  --rate 0.05 --min_new 256 --max_new 512 --quant on --kv_quant on \
+  --paged_kernel pallas --timeline_dir artifacts/r6/tl_longctx_decode_pallas \
+  --out artifacts/bench_serving_longctx_decode_pallas.json
 run xl_l6_u3 python - << 'PYEOF'
 # ONE cautious attempt to recover the L6-class XL headline: the full-
 # unroll L6/B20 program crashes the remote compile helper (PERF.md r5);

@@ -477,6 +477,63 @@ def test_prover_catches_bf16_accumulating_kernel(monkeypatch):
     )
 
 
+_BAND_CLAUSE = "shared: banded PV accumulation runs in pinned ascending-band order"
+
+
+@pytest.mark.parametrize("kern", ["xla", "pallas"])
+def test_prover_proves_banded_fold_order_multiband(kern, monkeypatch):
+    """Banded-accumulation-order clause (ISSUE 20), on a genuinely
+    multi-banded plan: force 2 pages per band so the PV fold has two
+    pool-band partials plus the recent/self partial, and the prover
+    must extract the pinned ascending offsets (0, 32, 64) — identical
+    for decode and verify, on the kernel body AND the banded XLA
+    reference — with every clause green."""
+    from midgpt_tpu.ops import paged_attn
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(paged_attn, "_FORCE_BAND_PAGES", 2)
+    try:
+        rep = prove_serving_choreography("openwebtext", paged_kernel=kern)
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert rep.ok, "\n".join(
+        f"{c.name}: {c.detail}" for c in rep.checks if not c.ok
+    )
+    order = {p.name: p.band_order for p in rep.programs}
+    assert order["decode_window"] == order["verify"] == (0, 32, 64)
+    # einsum-contracted programs have no fold: exempt by construction
+    assert order["prefill_chunk"] is None
+    assert order["naive_reference"] is None
+
+
+def test_prover_catches_descending_band_fold(monkeypatch):
+    """Fault injection (the ISSUE 20 clause): reverse the band fold —
+    banded_fold summing descending instead of the pinned ascending
+    order. f32 addition is not associative, so this is a bitwise drift
+    no dtype check can see; the prover must fail EXACTLY the band-order
+    clause while every sibling clause stays green (kernel == XLA
+    survives the flip because BOTH sides fold through banded_fold)."""
+    from midgpt_tpu.ops import paged_attn
+
+    engine_mod._PROGRAM_CACHE.clear()
+    monkeypatch.setattr(paged_attn, "_FORCE_BAND_PAGES", 2)
+    monkeypatch.setattr(paged_attn, "_BAND_FOLD_ORDER", "descending")
+    try:
+        rep = prove_serving_choreography(
+            "openwebtext", paged_kernel="pallas"
+        )
+    finally:
+        engine_mod._PROGRAM_CACHE.clear()
+    assert not rep.ok
+    checks = _checks(rep)
+    assert checks[_BAND_CLAUSE] is False
+    for name, ok in checks.items():
+        if name != _BAND_CLAUSE:
+            assert ok is True, name
+    detail = {c.name: c.detail for c in rep.checks}[_BAND_CLAUSE]
+    assert "band_order" in detail
+
+
 # ---------------------------------------------------------------------------
 # the sampled-verify prover (temperature > 0): the verify program's
 # rejection-sampling arithmetic proven against the decode window's

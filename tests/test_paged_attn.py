@@ -280,6 +280,69 @@ def test_verify_kernel_bitwise_vs_xla(kv_quant):
         np.testing.assert_array_equal(a, b)
 
 
+# f32-nb2 (2 bands) proves the multi-band fold in tier-1; the deeper
+# band counts and the int8-pool multiband cells ride the slow tier to
+# keep tier-1 inside the 870 s verify budget (the serving-longctx CI
+# job runs the banded legs fast + slow, and serving-choreo runs this
+# file unfiltered). int8 at NB=1 stays fast via the kv8 cells of
+# test_decode_kernel_bitwise_vs_xla above.
+@pytest.mark.parametrize(
+    "kv_quant,band_pages_",
+    [
+        pytest.param(None, 4, id="f32-nb2"),
+        pytest.param(None, 2, id="f32-nb4", marks=pytest.mark.slow),
+        pytest.param(None, 1, id="f32-nb8", marks=pytest.mark.slow),
+        pytest.param("int8", 4, id="kv8-nb2", marks=pytest.mark.slow),
+        pytest.param("int8", 2, id="kv8-nb4", marks=pytest.mark.slow),
+        pytest.param("int8", 1, id="kv8-nb8", marks=pytest.mark.slow),
+    ],
+)
+def test_banded_kernel_bitwise_vs_banded_xla(kv_quant, band_pages_,
+                                             monkeypatch):
+    """Genuinely MULTI-banded streaming (ISSUE 20): force the band plan
+    below the whole table (the auto-sizer picks one band at this tiny
+    geometry) and re-pin kernel == XLA to the f32 bit for decode AND
+    verify. Both sides slice per band and fold partials through
+    banded_fold, so this exercises the whole banded contract: per-band
+    masking, per-band dequant slices, and the pinned ascending fold —
+    at 8, 4, and 2 pages per band against the pmax=8 table."""
+    import midgpt_tpu.ops.paged_attn as pa
+
+    monkeypatch.setattr(pa, "_FORCE_BAND_PAGES", band_pages_)
+    cfg = GQA_CFG
+    model, pool, bt, pooled_len, tokens = _decode_setup(cfg, kv_quant)
+    l, s = cfg.n_layer, tokens.shape[0]
+    rk = jnp.zeros((l, s, cfg.kv_heads, 4, cfg.head_dim), pool.row_dtype)
+    rk = rk.at[:, :, :, 0, :].set(0.25)
+    rv = jnp.zeros_like(rk).at[:, :, :, 0, :].set(-0.5)
+    pos = pooled_len + 1
+    r = jnp.asarray(1, jnp.int32)
+    outs = {}
+    for kern in ("xla", "pallas"):
+        logits, _, _ = jax.jit(
+            lambda tk, pk, pv, b_, rk_, rv_, pl_, sk, sv: decode_step_paged(
+                model, tk, pos, pk, pv, b_, rk_, rv_, r, pl_,
+                cfg.block_size, pool_sk=sk, pool_sv=sv, paged_kernel=kern,
+            )
+        )(tokens, pool.k, pool.v, bt, rk, rv, pooled_len,
+          pool.scale_k, pool.scale_v)
+        outs[kern] = np.asarray(logits, np.float32)
+    np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+    cand = jax.random.randint(
+        jax.random.PRNGKey(9), (s, 3), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    vouts = {}
+    for kern in ("xla", "pallas"):
+        logits, _, _ = jax.jit(
+            lambda c_, pk, pv, b_, pl_, sk, sv: verify_tokens_paged(
+                model, c_, pl_, pk, pv, b_, cfg.block_size,
+                pool_sk=sk, pool_sv=sv, paged_kernel=kern,
+            )
+        )(cand, pool.k, pool.v, bt, pooled_len, pool.scale_k, pool.scale_v)
+        vouts[kern] = np.asarray(logits, np.float32)
+    np.testing.assert_array_equal(vouts["xla"], vouts["pallas"])
+
+
 # ---------------------------------------------------------------------------
 # engine token identity: the matrix with the kernel on
 # ---------------------------------------------------------------------------
@@ -520,43 +583,55 @@ def test_paged_kernel_auto_resolves_to_xla_on_cpu():
 
 
 def test_kernel_supported_gates_on_vmem():
+    """Band-aware gate (ISSUE 20): the working set is one band's
+    double-buffered K/V stream (+ its f32 dequant views) plus the
+    full-context f32 score/prob rows — O(band), not O(Pmax) — so the
+    contexts the whole-pool assembly used to reject now fit, while the
+    residency that CANNOT band (the flat-softmax score rows, scaled by
+    the REAL group count and spec length) still rejects honestly."""
     from midgpt_tpu.ops.paged_attn import supported
 
     assert supported(pmax=64, page_size=16, hkv=12, c=64, itemsize=2,
                      groups=1)
-    # a context long enough to blow the assembly budget is rejected
-    # (auto falls back to the XLA gather path)
-    assert not supported(pmax=4096, page_size=16, hkv=12, c=64,
-                         itemsize=2, groups=1)
-    # the fit must count the f32 dequant views of the assemblies, not
-    # just the pool-dtype scratch — an int8 pool is counted 1 byte/elt
-    # but the kernel materializes two 4-byte f32 copies, 8x the naive
-    # assembly figure (code-review finding): this geometry's naive
-    # count is ~8.4 MB but its real demand is ~25 MB
-    assert not supported(pmax=256, page_size=16, hkv=8, c=64,
-                         itemsize=1, groups=8)
-    # wide GQA groups scale the f32 score/prob scratch: the gate must
-    # count the REAL group size, not a fixed cap (code-review finding)
-    assert not supported(pmax=256, page_size=16, hkv=2, c=64,
-                         itemsize=2, groups=128)
-    # the verify kernel's scores are [Hkv, G, T, W]: a geometry that
-    # fits for decode can overflow once speculation multiplies the
-    # scratch by T = speculate + 1 (code-review finding)
+    # pre-banding this overflowed (~600 MB whole-pool assembly); the
+    # banded stream makes it a ~2.5 MB working set
+    assert supported(pmax=4096, page_size=16, hkv=12, c=64,
+                     itemsize=2, groups=1)
+    # int8 pool: the per-band f32 dequant views (4 counted bytes per
+    # 1-byte element) and the [Pmax] f32 scale planes still ride the
+    # arithmetic — per-band now, so this fits too (PR 9's accounting
+    # survives banding, applied to the band)
+    assert supported(pmax=256, page_size=16, hkv=8, c=64,
+                     itemsize=1, groups=8)
+    # what banding CANNOT shrink: the flat-softmax f32 score + prob
+    # rows are [G, T, W]-resident. Wide GQA groups scale them past the
+    # budget — the gate must count the REAL group size, not a cap
     assert supported(pmax=256, page_size=16, hkv=2, c=64, itemsize=2,
-                     groups=24)
-    assert not supported(pmax=256, page_size=16, hkv=2, c=64,
-                         itemsize=2, groups=24, spec_t=5)
+                     groups=128)
+    assert not supported(pmax=4096, page_size=16, hkv=2, c=64,
+                         itemsize=2, groups=128)
+    # ... and speculation multiplies the rows by T = speculate + 1: a
+    # geometry that fits for decode can overflow for verify
+    assert supported(pmax=4096, page_size=16, hkv=2, c=64, itemsize=2,
+                     groups=12)
+    assert not supported(pmax=4096, page_size=16, hkv=2, c=64,
+                         itemsize=2, groups=12, spec_t=5)
 
 
-def test_kernel_gate_rejects_100k_token_pmax():
-    """Long-context serving: at a 100k-token context the block table
-    spans ``pages_needed(100_000, 16) = 6250`` pages and the kernel's
-    VMEM assembly alone is ~0.9 GB. ``supported()`` must reject from
-    the byte arithmetic — any TP shard fraction, either pool dtype —
-    so ``auto`` can never hand an overflowing kernel to Mosaic; the
-    engine serves long prompts through the XLA gather path instead."""
+def test_kernel_gate_accepts_100k_token_pmax():
+    """Long-context decode (ISSUE 20): at a 100k-token context the
+    block table spans ``pages_needed(100_000, 16) = 6250`` pages. The
+    whole-pool assembly was ~0.9 GB (the old gate's rejection); the
+    banded working set is band-stream + score rows, and ``supported()``
+    now returns True for BOTH pool dtypes at a 12-wide GQA group. The
+    byte arithmetic is pinned exactly — band auto-sizing included —
+    so a regression in the plan (band too big, a dropped dequant view,
+    lost scale planes) moves a literal."""
     from midgpt_tpu.ops.paged_attn import (
+        BAND_VMEM_BUDGET,
+        DMA_DEPTH,
         VMEM_BUDGET,
+        band_pages,
         supported,
         vmem_bytes,
     )
@@ -565,30 +640,53 @@ def test_kernel_gate_rejects_100k_token_pmax():
     pmax = pages_needed(100_000, 16)
     assert pmax == 6250
     w = pmax * 16  # 100_000 resident positions
-    # pin the arithmetic itself, bf16 pool at a 12-head C=64 serving
-    # geometry: K+V assembly at pool dtype, the f32 dequant views on
-    # top, and the x4 f32 score/prob headroom
-    assembly = 2 * 12 * 64 * w * 2 + 2 * 12 * 64 * w * 4
-    scores = 4 * 12 * 1 * 1 * w * 4
-    assert vmem_bytes(pmax, 16, 12, 64, 2, groups=1) == assembly + scores
-    assert assembly + scores == 940_800_000  # ~75x the 12 MiB budget
-    assert not supported(pmax, 16, 12, 64, 2, groups=1)
-    # int8 pool: 1 counted byte/elt, but the kernel still materializes
-    # the two 4-byte f32 views — nowhere near fitting either
-    assert vmem_bytes(pmax, 16, 12, 64, 1, groups=1) == 787_200_000
-    assert not supported(pmax, 16, 12, 64, 1, groups=1)
-    # no realistic TP shard rescues it: even ONE KV head per chip
-    # carries a ~78 MB assembly at this Pmax
-    for hkv in (6, 3, 1):
-        assert vmem_bytes(pmax, 16, hkv, 64, 2, groups=1) > 6 * VMEM_BUDGET
-        assert not supported(pmax, 16, hkv, 64, 2, groups=1)
+    # band plan, bf16: largest divisor of 6250 whose K+V stream
+    # buffers (x DMA_DEPTH) + f32 dequant views fit the band budget
+    assert DMA_DEPTH == 2
+    assert band_pages(pmax, 16, 64, 2) == 125  # 50 bands of 2000 pos
+    band_bf16 = 2 * DMA_DEPTH * 64 * (125 * 16) * 2 \
+        + 2 * 64 * (125 * 16) * 4
+    assert band_bf16 == 2_048_000 <= BAND_VMEM_BUDGET
+    # the residency banding cannot shrink: [G, T, W] f32 score + prob
+    # rows, G=12 query heads per KV head, decode T=1
+    scores = 2 * 12 * 1 * w * 4
+    assert vmem_bytes(pmax, 16, 12, 64, 2, groups=12) \
+        == band_bf16 + scores == 11_648_000 <= VMEM_BUDGET
+    assert supported(pmax, 16, 12, 64, 2, groups=12)
+    # int8 pool: thinner stream, same dequant views, plus the [Pmax]
+    # f32 scale planes (K and V)
+    assert band_pages(pmax, 16, 64, 1) == 125
+    band_int8 = 2 * DMA_DEPTH * 64 * (125 * 16) * 1 \
+        + 2 * 64 * (125 * 16) * 4
+    assert vmem_bytes(pmax, 16, 12, 64, 1, groups=12) \
+        == band_int8 + scores + 2 * pmax * 4 == 11_186_000
+    assert supported(pmax, 16, 12, 64, 1, groups=12)
+    # hkv no longer enters: the grid runs over (slot x KV head), so
+    # per-program residency is head-count-free
+    for hkv in (12, 6, 3, 1):
+        assert vmem_bytes(pmax, 16, hkv, 64, 2, groups=1) == 2_848_000
+        assert vmem_bytes(pmax, 16, hkv, 64, 1, groups=1) == 2_386_000
+    # verify still gated: speculation multiplies the score rows by T
+    assert not supported(pmax, 16, 12, 64, 2, groups=12, spec_t=2)
+    # adversarial geometry overflowing even a ONE-page band (C so wide
+    # the smallest stream buffer exceeds the band budget): band_pages
+    # finds no plan and the gate reports the honest whole-table cost
+    assert band_pages(pmax, 16, 16384, 2) is None
+    assert not supported(pmax, 16, 1, 16384, 2)
+    # pathologically-factored Pmax: a prime page count's only fitting
+    # divisor is 1, which needs > MAX_BANDS bands — no plan, honest
+    # whole-table fallback, rejected
+    assert band_pages(6247, 16, 64, 2) is None
+    assert not supported(6247, 16, 12, 64, 2, groups=12)
 
 
-def test_auto_kernel_falls_back_to_xla_at_long_context(monkeypatch):
-    """``auto`` consults the gate with the LONG-context Pmax: with the
-    backend forced to TPU, a 100k-block model still resolves to the
-    XLA gather fallback while the short-block model picks the kernel —
-    the resolution gates on geometry, not platform alone."""
+def test_auto_kernel_selects_pallas_at_long_context(monkeypatch):
+    """``auto`` consults the band-aware gate with the LONG-context
+    Pmax: with the backend forced to TPU, a 100k-block model now
+    resolves to the Pallas kernel (the banded working set fits) —
+    while a block size whose prime page count defeats the band plan
+    still falls back to XLA honestly. Resolution gates on geometry,
+    not platform alone."""
     import midgpt_tpu.utils.platform as platform
 
     monkeypatch.setattr(platform, "is_tpu_backend", lambda: True)
@@ -597,11 +695,19 @@ def test_auto_kernel_falls_back_to_xla_at_long_context(monkeypatch):
         _model(long_cfg), slots=1, page_size=16, window=2,
         num_pages=8, paged_kernel="auto",
     )
-    assert eng.paged_kernel == "xla"
+    assert eng.paged_kernel == "pallas"
     eng_short = ServingEngine(
         _model(), slots=1, page_size=16, window=2, paged_kernel="auto"
     )
     assert eng_short.paged_kernel == "pallas"
+    # 99_952 tokens -> 6247 pages (prime): no band plan fits MAX_BANDS,
+    # the gate reports the whole-table cost, auto falls back
+    prime_cfg = dataclasses.replace(CFG, block_size=99_952)
+    eng_prime = ServingEngine(
+        _model(prime_cfg), slots=1, page_size=16, window=2,
+        num_pages=8, paged_kernel="auto",
+    )
+    assert eng_prime.paged_kernel == "xla"
 
 
 def test_engine_rejects_unknown_kv_quant():

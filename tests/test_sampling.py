@@ -274,6 +274,9 @@ def test_generate_flash_configured_unaligned_prompt(pallas_interpret):
     assert toks.shape == (1, 4)
 
 
+@pytest.mark.slow  # >20 s (24 unjitted oracle forwards, one compile per
+# growing crop shape) — moved off tier-1 per conftest's >20 s convention;
+# CI home: hlo-audit's slow-tier step
 def test_generate_past_block_size_matches_sliding_window_oracle():
     """Generation beyond block_size: the ring-buffer cache must reproduce
     the reference's sliding-window conditioning (sample.py:74

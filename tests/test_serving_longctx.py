@@ -231,6 +231,57 @@ def test_spill_sampled_identity(model):
     _check(eng)
 
 
+def test_spill_prefetch_batches_faultbacks_and_keeps_streams(
+    model, monkeypatch
+):
+    """Prefetch-on-queue (ISSUE 20): the scheduler probes the wait-queue
+    head's prompt each step and fault-backs its matched spilled nodes
+    BEFORE admission in ONE batched import_pages call. Streams must stay
+    bitwise identical to fault-on-match (imports are byte-exact either
+    way) while the import dispatch count on the TTFT path DROPS — the
+    per-node fault_back calls collapse into per-step batches."""
+    import midgpt_tpu.serving.engine as engine_mod
+
+    prompts = _prompts(4, base_len=22, stride=0, seed0=700)
+    kw = dict(page_size=8, prefill_chunk=8, prefix_cache=True)
+    ref, _ = _run(model, None, prompts, 12, **kw)
+    real_import = engine_mod.import_pages
+    calls = {}
+    engines = {}
+    for mode in ("off", "on"):
+        counter = {"n": 0}
+
+        def counting(pool, ids, *a, _c=counter, **k2):
+            _c["n"] += 1
+            return real_import(pool, ids, *a, **k2)
+
+        monkeypatch.setattr(engine_mod, "import_pages", counting)
+        got, eng = _run(
+            model, None, prompts, 12, num_pages=8, spill="on",
+            spill_prefetch=mode, **kw
+        )
+        assert got == ref
+        assert eng.stats()["spilled_pages"] > 0
+        assert eng.stats()["spill_resident_pages"] > 0
+        _check(eng)
+        # resubmit the same prompts: matches walk onto spilled nodes —
+        # the import calls from HERE to stream completion are the
+        # revival dispatches on the resubmits' TTFT path
+        base = counter["n"]
+        rids = [eng.submit(p, 12, seed=i) for i, p in enumerate(prompts)]
+        fin = eng.run()
+        assert [list(map(int, fin[r].tokens)) for r in rids] == ref
+        _check(eng)
+        calls[mode] = counter["n"] - base
+        engines[mode] = eng
+    st_on, st_off = engines["on"].stats(), engines["off"].stats()
+    assert st_off["spill_prefetch_pages"] == 0
+    assert st_on["spill_prefetch_pages"] > 0
+    assert st_on["spill_faultback_pages"] > 0
+    assert calls["on"] > 0 and calls["off"] > 0
+    assert calls["on"] < calls["off"], (calls, st_on, st_off)
+
+
 def test_eviction_under_pressure_mid_spill(model):
     """spill_budget_pages bounds host residency: past it the oldest
     spilled prefixes are discarded outright (true reclaim resumes, the

@@ -681,9 +681,15 @@ def test_bench_serving_longctx_record_contract(tmp_path):
     assert rec["serve_num_pages"] == 10
     assert rec["serve_spilled_pages"] > 0
     assert rec["serve_spill_resident_pages"] > 0
-    for k in ("serve_spill_faultback_pages", "serve_spill_readmissions",
-              "serve_spill_discards"):
+    for k in ("serve_spill_faultback_pages", "serve_spill_prefetch_pages",
+              "serve_spill_readmissions", "serve_spill_discards"):
         assert isinstance(rec[k], int) and rec[k] >= 0, k
+    # requested vs resolved kernel (ISSUE 20): the record carries BOTH —
+    # a long-context row claiming pallas cannot hide an XLA fallback.
+    # This CPU run requested the default "auto" and must have resolved
+    # to a concrete backend (xla off-TPU).
+    assert rec["serve_paged_kernel"] == "auto"
+    assert rec["serve_paged_kernel_resolved"] == "xla"
     # no-wedge: everything finished, nothing shed or deferred
     assert rec["serve_requests_finished"] == rec["serve_requests"]
     assert rec["serve_shed_requests"] == 0

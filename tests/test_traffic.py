@@ -254,6 +254,38 @@ def test_audit_traffic_within_checked_in_budget():
 
 
 @pytest.mark.slow
+def test_budget_cells_invariant_to_banding():
+    """Banding moves ZERO bytes (ISSUE 20): the banded PV fold slices
+    the same streams the unbanded reduction read — each K/V byte still
+    crosses HBM exactly once per pass — so every decode-window traffic
+    cell must land in the SAME checked-in budget band with a genuinely
+    multi-banded plan forced as with the auto plan (one band at this
+    geometry), and the two audits' classified per-stream totals must be
+    byte-identical."""
+    import midgpt_tpu.ops.paged_attn as pa
+    from midgpt_tpu.analysis.harness import audit_decode_window
+
+    _, report, traf = audit_decode_window(
+        "openwebtext", slots=4, window=4, page_size=16, traffic=True
+    )
+    assert report.ok
+    old = pa._FORCE_BAND_PAGES
+    pa._FORCE_BAND_PAGES = 2
+    try:
+        _, report_b, traf_b = audit_decode_window(
+            "openwebtext", slots=4, window=4, page_size=16, traffic=True
+        )
+    finally:
+        pa._FORCE_BAND_PAGES = old
+    assert report_b.ok
+    budget = budget_for("decode_window", "bf16", "single")
+    assert check_budget(traf_b, budget) == [], check_budget(traf_b, budget)
+    assert dict(traf_b.streams) == dict(traf.streams), (
+        traf_b.streams, traf.streams
+    )
+
+
+@pytest.mark.slow
 def test_model_closure_trips_budget_gate():
     """Re-introduce the PR 6 bug: a decode window that CLOSES OVER the
     model instead of taking it as an entry parameter. The weights leave
